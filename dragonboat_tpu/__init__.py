@@ -1,0 +1,57 @@
+"""dragonboat-tpu: a TPU-native multi-group Raft consensus framework.
+
+A re-imagining of Dragonboat (github.com/lni/dragonboat v3.2 line) for TPU:
+one NodeHost process hosts thousands of Raft groups, and the per-group
+protocol step loop is replaced by a single vectorized JAX kernel that
+advances all groups' protocol state — term, vote, matchIndex, commitIndex
+tensors over a (groups, peers) layout — in one compiled step. Host-side
+control plane (log storage, transport, snapshots, state machines) keeps
+Dragonboat's pluggable seams.
+
+Layers:
+  - types/config/client: wire types, configuration, client sessions
+  - core: scalar (per-group) Raft protocol oracle
+  - ops: the vectorized multi-group protocol kernel (JAX)
+  - engine: batched execution engine driving the kernel
+  - storage: pluggable log storage (LogDB)
+  - transport: pluggable message transport
+  - rsm: replicated state machine management
+  - nodehost: the public facade
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, EngineConfig, NodeHostConfig
+from .client import Session
+from .types import (
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+    SystemCtx,
+    Update,
+)
+
+__all__ = [
+    "Config",
+    "EngineConfig",
+    "NodeHostConfig",
+    "Session",
+    "ConfigChange",
+    "ConfigChangeType",
+    "Entry",
+    "EntryType",
+    "Membership",
+    "Message",
+    "MessageType",
+    "Snapshot",
+    "State",
+    "SystemCtx",
+    "Update",
+    "__version__",
+]

@@ -1,0 +1,93 @@
+"""Client sessions for at-most-once proposal semantics
+(cf. client/session.go:23-167).
+
+A Session tracks (client_id, series_id, responded_to); the RSM layer keeps an
+LRU of applied results keyed by these ids so that a retried proposal returns
+the cached result instead of being applied twice (Raft thesis section 6.3).
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from .types import (
+    NOOP_CLIENT_ID,
+    NOOP_SERIES_ID,
+    SERIES_ID_FIRST_PROPOSAL,
+    SERIES_ID_FOR_REGISTER,
+    SERIES_ID_FOR_UNREGISTER,
+)
+
+
+@dataclass
+class Session:
+    cluster_id: int = 0
+    client_id: int = NOOP_CLIENT_ID
+    series_id: int = NOOP_SERIES_ID
+    responded_to: int = 0
+
+    @staticmethod
+    def new_session(cluster_id: int) -> "Session":
+        # Random non-reserved client id, cf. client/session.go NewSession.
+        while True:
+            cid = secrets.randbits(63)
+            if cid not in (NOOP_CLIENT_ID,):
+                break
+        return Session(
+            cluster_id=cluster_id,
+            client_id=cid,
+            series_id=SERIES_ID_FIRST_PROPOSAL - 1,
+        )
+
+    @staticmethod
+    def noop_session(cluster_id: int) -> "Session":
+        return Session(
+            cluster_id=cluster_id,
+            client_id=NOOP_CLIENT_ID,
+            series_id=NOOP_SERIES_ID,
+        )
+
+    def is_noop_session(self) -> bool:
+        return self.client_id == NOOP_CLIENT_ID
+
+    def prepare_for_register(self) -> None:
+        self._assert_regular()
+        self.series_id = SERIES_ID_FOR_REGISTER
+
+    def prepare_for_unregister(self) -> None:
+        self._assert_regular()
+        self.series_id = SERIES_ID_FOR_UNREGISTER
+
+    def prepare_for_propose(self) -> None:
+        self._assert_regular()
+        self.series_id = SERIES_ID_FIRST_PROPOSAL
+
+    def proposal_completed(self) -> None:
+        """Must be called after each successfully completed proposal so the
+        RSM can evict the cached result (cf. session.go:109-120)."""
+        self._assert_regular()
+        if self.series_id != self.responded_to + 1:
+            raise RuntimeError("invalid responded_to/series_id values")
+        self.responded_to = self.series_id
+        self.series_id += 1
+
+    def valid_for_proposal(self, cluster_id: int) -> bool:
+        if self.is_noop_session():
+            return cluster_id == self.cluster_id
+        if self.series_id in (SERIES_ID_FOR_REGISTER, SERIES_ID_FOR_UNREGISTER):
+            return False
+        return (
+            self.cluster_id == cluster_id and self.responded_to <= self.series_id
+        )
+
+    def valid_for_session_op(self, cluster_id: int) -> bool:
+        if self.is_noop_session():
+            return False
+        return self.cluster_id == cluster_id and self.series_id in (
+            SERIES_ID_FOR_REGISTER,
+            SERIES_ID_FOR_UNREGISTER,
+        )
+
+    def _assert_regular(self) -> None:
+        if self.is_noop_session():
+            raise RuntimeError("not supported on noop session")

@@ -1,0 +1,149 @@
+"""Configuration for Raft groups and NodeHost instances.
+
+Mirrors the three-tier config system of the reference (cf. config/config.go:60-169
+for the per-group Config, config/config.go:211-307 for NodeHostConfig) with the
+same validation rules, plus TPU-engine specific knobs (EngineConfig) that have
+no referent in the Go implementation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .types import CompressionType
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class Config:
+    """Per-Raft-group configuration (cf. config/config.go:60-169)."""
+
+    node_id: int = 0
+    cluster_id: int = 0
+    check_quorum: bool = False
+    election_rtt: int = 0
+    heartbeat_rtt: int = 0
+    snapshot_entries: int = 0
+    compaction_overhead: int = 0
+    ordered_config_change: bool = False
+    max_in_mem_log_size: int = 0
+    snapshot_compression_type: CompressionType = CompressionType.NO_COMPRESSION
+    entry_compression_type: CompressionType = CompressionType.NO_COMPRESSION
+    is_observer: bool = False
+    is_witness: bool = False
+    quiesce: bool = False
+
+    def validate(self) -> None:
+        # cf. config/config.go:176-208 Validate
+        if self.node_id == 0:
+            raise ConfigError("invalid NodeID, it must be >= 1")
+        if self.heartbeat_rtt == 0:
+            raise ConfigError("HeartbeatRTT must be > 0")
+        if self.election_rtt == 0:
+            raise ConfigError("ElectionRTT must be > 0")
+        if self.election_rtt <= 2 * self.heartbeat_rtt:
+            raise ConfigError(
+                "invalid election rtt, ElectionRTT must be > 2 * HeartbeatRTT"
+            )
+        if self.max_in_mem_log_size > 0 and self.max_in_mem_log_size < 64:
+            raise ConfigError("MaxInMemLogSize is too small")
+        if self.is_witness and self.snapshot_entries > 0:
+            raise ConfigError("witness node can not take snapshot")
+        if self.is_witness and self.is_observer:
+            raise ConfigError("witness node can not be an observer")
+
+    def get_max_in_mem_log_size(self) -> int:
+        if self.max_in_mem_log_size == 0:
+            return 2**63 - 1
+        return self.max_in_mem_log_size
+
+
+@dataclass
+class EngineConfig:
+    """TPU batched-engine knobs; no referent in the reference implementation.
+
+    The vectorized engine advances all groups in a fixed-capacity tensor
+    program; these values bound the static shapes of that program. Larger
+    values raise per-step HBM footprint but amortize kernel-launch overhead
+    over more protocol work.
+    """
+
+    # Max Raft groups per NodeHost; the G dimension of the kernel tensors.
+    max_groups: int = 1024
+    # Max peers per group (incl. self); the P dimension.
+    max_peers: int = 8
+    # Device-resident log window per group (entries of (term) metadata).
+    log_window: int = 512
+    # Max inbound protocol messages consumed per group per kernel step.
+    inbox_depth: int = 8
+    # Max outstanding ReadIndex system contexts per group on device.
+    readindex_depth: int = 4
+    # Max proposal batches appended per group per step.
+    proposal_lanes: int = 1
+    # How many protocol micro-steps (inbox drain rounds) per kernel launch.
+    micro_steps: int = 1
+
+
+@dataclass
+class NodeHostConfig:
+    """Per-process configuration (cf. config/config.go:211-307)."""
+
+    deployment_id: int = 0
+    wal_dir: str = ""
+    nodehost_dir: str = ""
+    rtt_millisecond: int = 0
+    raft_address: str = ""
+    listen_address: str = ""
+    mutual_tls: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    max_send_queue_size: int = 0
+    max_receive_queue_size: int = 0
+    logdb_factory: Optional[Callable] = None
+    raft_rpc_factory: Optional[Callable] = None
+    enable_metrics: bool = False
+    raft_event_listener: Optional[object] = None
+    system_event_listener: Optional[object] = None
+    max_snapshot_send_bytes_per_second: int = 0
+    max_snapshot_recv_bytes_per_second: int = 0
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+    def validate(self) -> None:
+        # cf. config/config.go:309-345 Validate
+        if self.rtt_millisecond == 0:
+            raise ConfigError("invalid RTTMillisecond")
+        if not _is_valid_address(self.raft_address):
+            raise ConfigError("invalid NodeHost address")
+        if self.listen_address and not _is_valid_address(self.listen_address):
+            raise ConfigError("invalid ListenAddress")
+        if self.mutual_tls:
+            if not self.ca_file:
+                raise ConfigError("CA file not specified")
+            if not self.cert_file:
+                raise ConfigError("cert file not specified")
+            if not self.key_file:
+                raise ConfigError("key file not specified")
+        if 0 < self.max_send_queue_size < 64:
+            raise ConfigError("MaxSendQueueSize value is too small")
+        if 0 < self.max_receive_queue_size < 64:
+            raise ConfigError("MaxReceiveQueueSize value is too small")
+
+    def get_listen_address(self) -> str:
+        return self.listen_address or self.raft_address
+
+
+def _is_valid_address(addr: str) -> bool:
+    if not addr or ":" not in addr:
+        return False
+    host, _, port = addr.rpartition(":")
+    if not host:
+        return False
+    try:
+        p = int(port)
+    except ValueError:
+        return False
+    return 0 < p < 65536

@@ -1,0 +1,30 @@
+"""Scalar (per-group) Raft protocol core.
+
+This package is the pure-Python reference implementation of the Raft protocol
+with Dragonboat's exact semantics (cf. /root/reference/internal/raft/). It has
+two jobs:
+
+1. It is the *oracle* for differential testing of the vectorized TPU kernel in
+   dragonboat_tpu.ops: same message trace in => same Updates out.
+2. It is the fallback slow path for protocol events the batched kernel defers
+   to the host (snapshot restore, membership reconfiguration).
+"""
+from .peer import Peer, PeerAddress, launch_peer
+from .raft import Raft, RaftNodeState
+from .logentry import EntryLog, ILogDB, InMemLogDB
+from .remote import Remote, RemoteState
+from .readindex import ReadIndexTracker
+
+__all__ = [
+    "Peer",
+    "PeerAddress",
+    "launch_peer",
+    "Raft",
+    "RaftNodeState",
+    "EntryLog",
+    "ILogDB",
+    "InMemLogDB",
+    "Remote",
+    "RemoteState",
+    "ReadIndexTracker",
+]

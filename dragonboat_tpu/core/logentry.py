@@ -1,0 +1,453 @@
+"""Two-tier Raft log view: stable storage (ILogDB) + recent in-memory entries.
+
+Semantics follow the reference's entryLog/inMemory pair
+(cf. internal/raft/logentry.go:78-401, internal/raft/inmemory.go:36-246):
+the in-memory tier holds entries not yet applied, with a saved_to watermark
+tracking what has been fsynced; term lookups merge both tiers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Protocol, Tuple
+
+from ..types import (
+    Entry,
+    Membership,
+    Snapshot,
+    State,
+    UpdateCommit,
+    assert_contiguous,
+    limit_entry_size,
+)
+from .. import settings
+
+
+class ErrCompacted(Exception):
+    """The requested log range has been compacted away."""
+
+
+class ErrUnavailable(Exception):
+    """The requested log range is beyond the last index."""
+
+
+class ILogDB(Protocol):
+    """Read view over stable log storage used by the Raft core
+    (cf. internal/raft/logentry.go:45-73)."""
+
+    def node_state(self) -> Tuple[State, Membership]: ...
+
+    def get_range(self) -> Tuple[int, int]:  # (first_index, last_index)
+        ...
+
+    def term(self, index: int) -> int:  # raises ErrCompacted/ErrUnavailable
+        ...
+
+    def entries(self, low: int, high: int, max_size: int) -> List[Entry]: ...
+
+    def snapshot(self) -> Snapshot: ...
+
+    def append(self, entries: List[Entry]) -> None: ...
+
+    def apply_snapshot(self, ss: Snapshot) -> None: ...
+
+    def set_state(self, st: State) -> None: ...
+
+    def create_snapshot(self, ss: Snapshot) -> None: ...
+
+    def compact(self, index: int) -> None: ...
+
+
+class InMemLogDB:
+    """In-memory ILogDB used by tests and by the loopback slice; mirrors the
+    reference's TestLogDB (internal/raft/logdb_test.go) with LogReader-style
+    marker semantics."""
+
+    def __init__(self) -> None:
+        self._state = State()
+        self._membership = Membership()
+        # entries[0] is a marker entry at (marker_index, marker_term).
+        self._marker_index = 0
+        self._marker_term = 0
+        self._entries: List[Entry] = []
+        self._snapshot = Snapshot()
+
+    # -- read view -----------------------------------------------------------
+    def node_state(self) -> Tuple[State, Membership]:
+        return self._state, self._membership
+
+    def get_range(self) -> Tuple[int, int]:
+        return self._marker_index + 1, self._marker_index + len(self._entries)
+
+    def term(self, index: int) -> int:
+        if index == self._marker_index:
+            return self._marker_term
+        if index < self._marker_index:
+            raise ErrCompacted
+        if index > self._marker_index + len(self._entries):
+            raise ErrUnavailable
+        return self._entries[index - self._marker_index - 1].term
+
+    def entries(self, low: int, high: int, max_size: int) -> List[Entry]:
+        if low <= self._marker_index:
+            raise ErrCompacted
+        if high > self._marker_index + len(self._entries) + 1:
+            raise ErrUnavailable
+        ents = self._entries[
+            low - self._marker_index - 1 : high - self._marker_index - 1
+        ]
+        return limit_entry_size(list(ents), max_size)
+
+    def snapshot(self) -> Snapshot:
+        return self._snapshot
+
+    # -- write path ----------------------------------------------------------
+    def append(self, entries: List[Entry]) -> None:
+        if not entries:
+            return
+        assert_contiguous(entries)
+        first = entries[0].index
+        if first <= self._marker_index:
+            raise RuntimeError(
+                f"appending at {first} below marker {self._marker_index}"
+            )
+        if first > self._marker_index + len(self._entries) + 1:
+            raise RuntimeError(
+                f"log hole: append at {first}, last {self._marker_index + len(self._entries)}"
+            )
+        keep = first - self._marker_index - 1
+        self._entries = self._entries[:keep] + list(entries)
+
+    def set_state(self, st: State) -> None:
+        self._state = st
+
+    def set_membership(self, m: Membership) -> None:
+        self._membership = m
+
+    def apply_snapshot(self, ss: Snapshot) -> None:
+        self._snapshot = ss
+        self._marker_index = ss.index
+        self._marker_term = ss.term
+        self._entries = []
+
+    def create_snapshot(self, ss: Snapshot) -> None:
+        self._snapshot = ss
+
+    def compact(self, index: int) -> None:
+        if index <= self._marker_index:
+            raise ErrCompacted
+        last = self._marker_index + len(self._entries)
+        if index > last:
+            raise ErrUnavailable
+        term = self.term(index)
+        self._entries = self._entries[index - self._marker_index :]
+        self._marker_index = index
+        self._marker_term = term
+
+
+class InMemory:
+    """Recent, not-yet-applied log entries with a saved-to watermark
+    (cf. internal/raft/inmemory.go)."""
+
+    __slots__ = ("entries", "marker_index", "saved_to", "snapshot")
+
+    def __init__(self, last_index: int) -> None:
+        self.entries: List[Entry] = []
+        self.marker_index = last_index + 1
+        self.saved_to = last_index
+        self.snapshot: Optional[Snapshot] = None
+
+    def get_entries(self, low: int, high: int) -> List[Entry]:
+        upper = self.marker_index + len(self.entries)
+        if low > high or low < self.marker_index:
+            raise RuntimeError(
+                f"invalid range [{low},{high}) marker {self.marker_index}"
+            )
+        if high > upper:
+            raise RuntimeError(f"invalid high {high}, upper {upper}")
+        return self.entries[low - self.marker_index : high - self.marker_index]
+
+    def get_snapshot_index(self) -> Optional[int]:
+        return self.snapshot.index if self.snapshot is not None else None
+
+    def get_last_index(self) -> Optional[int]:
+        if self.entries:
+            return self.entries[-1].index
+        return self.get_snapshot_index()
+
+    def get_term(self, index: int) -> Optional[int]:
+        if index < self.marker_index:
+            si = self.get_snapshot_index()
+            if si is not None and si == index:
+                return self.snapshot.term
+            return None
+        last = self.get_last_index()
+        if last is not None and index <= last:
+            return self.entries[index - self.marker_index].term
+        return None
+
+    def entries_to_save(self) -> List[Entry]:
+        idx = self.saved_to + 1
+        if idx - self.marker_index > len(self.entries):
+            return []
+        return self.entries[idx - self.marker_index :]
+
+    def saved_log_to(self, index: int, term: int) -> None:
+        if index < self.marker_index or not self.entries:
+            return
+        if (
+            index > self.entries[-1].index
+            or term != self.entries[index - self.marker_index].term
+        ):
+            return
+        self.saved_to = index
+
+    def applied_log_to(self, index: int) -> None:
+        if index < self.marker_index or not self.entries:
+            return
+        if index > self.entries[-1].index:
+            return
+        self.entries = self.entries[index - self.marker_index :]
+        self.marker_index = index
+
+    def saved_snapshot_to(self, index: int) -> None:
+        si = self.get_snapshot_index()
+        if si is not None and si == index:
+            self.snapshot = None
+
+    def commit_update(self, cu: UpdateCommit) -> None:
+        if cu.stable_log_to > 0:
+            self.saved_log_to(cu.stable_log_to, cu.stable_log_term)
+        if cu.stable_snapshot_to > 0:
+            self.saved_snapshot_to(cu.stable_snapshot_to)
+
+    def merge(self, ents: List[Entry]) -> None:
+        first_new = ents[0].index
+        tail = self.marker_index + len(self.entries)
+        if first_new == tail:
+            self.entries = self.entries + list(ents)
+        elif first_new <= self.marker_index:
+            self.marker_index = first_new
+            self.entries = list(ents)
+            self.saved_to = first_new - 1
+        else:
+            existing = self.get_entries(self.marker_index, first_new)
+            self.entries = list(existing) + list(ents)
+            self.saved_to = min(self.saved_to, first_new - 1)
+
+    def restore(self, ss: Snapshot) -> None:
+        self.snapshot = ss
+        self.marker_index = ss.index + 1
+        self.entries = []
+        self.saved_to = ss.index
+
+
+class EntryLog:
+    """Merged log view over ILogDB + InMemory; tracks committed/processed
+    cursors (cf. internal/raft/logentry.go:78-84)."""
+
+    __slots__ = ("logdb", "inmem", "committed", "processed")
+
+    def __init__(self, logdb: ILogDB) -> None:
+        first_index, last_index = logdb.get_range()
+        self.logdb = logdb
+        self.inmem = InMemory(last_index)
+        self.committed = first_index - 1
+        self.processed = first_index - 1
+
+    # -- index boundaries ----------------------------------------------------
+    def first_index(self) -> int:
+        si = self.inmem.get_snapshot_index()
+        if si is not None:
+            return si + 1
+        return self.logdb.get_range()[0]
+
+    def last_index(self) -> int:
+        li = self.inmem.get_last_index()
+        if li is not None:
+            return li
+        return self.logdb.get_range()[1]
+
+    def _term_entry_range(self) -> Tuple[int, int]:
+        return self.first_index() - 1, self.last_index()
+
+    def _entry_range(self) -> Optional[Tuple[int, int]]:
+        if self.inmem.snapshot is not None and not self.inmem.entries:
+            return None
+        return self.first_index(), self.last_index()
+
+    def last_term(self) -> int:
+        return self.term(self.last_index())
+
+    def term(self, index: int) -> int:
+        """Returns 0 for out-of-window indexes (matching the reference's
+        (0, nil) return); raises ErrCompacted/ErrUnavailable when storage
+        reports them for in-window indexes."""
+        first, last = self._term_entry_range()
+        if index < first or index > last:
+            return 0
+        t = self.inmem.get_term(index)
+        if t is not None:
+            return t
+        return self.logdb.term(index)
+
+    # -- entry access --------------------------------------------------------
+    def _check_bound(self, low: int, high: int) -> None:
+        if low > high:
+            raise RuntimeError(f"input low {low} > high {high}")
+        rng = self._entry_range()
+        if rng is None:
+            raise ErrCompacted
+        first, last = rng
+        if low < first:
+            raise ErrCompacted
+        if high > last + 1:
+            raise RuntimeError(
+                f"requested range [{low},{high}) out of bound [{first},{last}]"
+            )
+
+    def get_entries(self, low: int, high: int, max_size: int) -> List[Entry]:
+        self._check_bound(low, high)
+        if low == high:
+            return []
+        marker = self.inmem.marker_index
+        ents: List[Entry] = []
+        if low < marker:
+            ents = self.logdb.entries(low, min(high, marker), max_size)
+            if len(ents) < min(high, marker) - low:
+                # storage truncated by max_size; don't cross into inmem
+                return ents
+        if high > marker:
+            lower = max(low, marker)
+            inmem = self.inmem.get_entries(lower, high)
+            if inmem:
+                ents = list(ents) + list(inmem)
+        return limit_entry_size(ents, max_size)
+
+    def entries(self, start: int, max_size: int) -> List[Entry]:
+        if start > self.last_index():
+            return []
+        return self.get_entries(start, self.last_index() + 1, max_size)
+
+    def get_snapshot(self) -> Snapshot:
+        if self.inmem.snapshot is not None:
+            return self.inmem.snapshot
+        return self.logdb.snapshot()
+
+    # -- apply cursors -------------------------------------------------------
+    def first_not_applied_index(self) -> int:
+        return max(self.processed + 1, self.first_index())
+
+    def to_apply_index_limit(self) -> int:
+        return self.committed + 1
+
+    def has_entries_to_apply(self) -> bool:
+        return self.to_apply_index_limit() > self.first_not_applied_index()
+
+    def has_more_entries_to_apply(self, applied_to: int) -> bool:
+        return self.committed > applied_to
+
+    def entries_to_apply(self, limit: Optional[int] = None) -> List[Entry]:
+        if limit is None:
+            limit = settings.soft.max_entries_to_apply_size
+        if self.has_entries_to_apply():
+            return self.get_entries(
+                self.first_not_applied_index(), self.to_apply_index_limit(), limit
+            )
+        return []
+
+    def entries_to_save(self) -> List[Entry]:
+        return self.inmem.entries_to_save()
+
+    # -- append/commit -------------------------------------------------------
+    def try_append(self, index: int, ents: List[Entry]) -> bool:
+        conflict = self.get_conflict_index(ents)
+        if conflict != 0:
+            if conflict <= self.committed:
+                raise RuntimeError(
+                    f"entry {conflict} conflicts with committed entry "
+                    f"(committed {self.committed})"
+                )
+            self.append(ents[conflict - index - 1 :])
+            return True
+        return False
+
+    def append(self, entries: List[Entry]) -> None:
+        if not entries:
+            return
+        if entries[0].index <= self.committed:
+            raise RuntimeError(
+                f"committed entries being changed, committed {self.committed}, "
+                f"first index {entries[0].index}"
+            )
+        self.inmem.merge(entries)
+
+    def get_conflict_index(self, entries: List[Entry]) -> int:
+        for e in entries:
+            if not self.match_term(e.index, e.term):
+                return e.index
+        return 0
+
+    def commit_to(self, index: int) -> None:
+        if index <= self.committed:
+            return
+        if index > self.last_index():
+            raise RuntimeError(
+                f"invalid commitTo index {index}, lastIndex {self.last_index()}"
+            )
+        self.committed = index
+
+    def commit_update(self, cu: UpdateCommit) -> None:
+        self.inmem.commit_update(cu)
+        if cu.processed > 0:
+            if cu.processed < self.processed or cu.processed > self.committed:
+                raise RuntimeError(
+                    f"invalid processed {cu.processed}, "
+                    f"current {self.processed}, committed {self.committed}"
+                )
+            self.processed = cu.processed
+        if cu.last_applied > 0:
+            if cu.last_applied > self.committed or cu.last_applied > self.processed:
+                raise RuntimeError(
+                    f"invalid last_applied {cu.last_applied}, "
+                    f"committed {self.committed} processed {self.processed}"
+                )
+            self.inmem.applied_log_to(cu.last_applied)
+
+    def match_term(self, index: int, term: int) -> bool:
+        try:
+            t = self.term(index)
+        except (ErrCompacted, ErrUnavailable):
+            return False
+        return t == term
+
+    def up_to_date(self, index: int, term: int) -> bool:
+        last_term = self.term(self.last_index())
+        if term > last_term:
+            return True
+        if term == last_term:
+            return index >= self.last_index()
+        return False
+
+    def try_commit(self, index: int, term: int) -> bool:
+        if index <= self.committed:
+            return False
+        try:
+            lterm = self.term(index)
+        except ErrCompacted:
+            lterm = 0
+        if index > self.committed and lterm == term:
+            self.commit_to(index)
+            return True
+        return False
+
+    def get_uncommitted_entries(self) -> List[Entry]:
+        last = self.inmem.marker_index + len(self.inmem.entries)
+        if last <= self.committed + 1:
+            return []
+        low = max(self.committed + 1, self.inmem.marker_index)
+        return self.inmem.get_entries(low, last)
+
+    def restore(self, ss: Snapshot) -> None:
+        self.inmem.restore(ss)
+        self.committed = ss.index
+        self.processed = ss.index

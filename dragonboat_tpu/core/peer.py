@@ -1,0 +1,331 @@
+"""Peer: the message-passing facade over the scalar Raft state machine.
+
+Everything — ticks, proposals, config changes, leadership transfer — enters
+the protocol as a Message; results leave as an Update via the etcd-style
+GetUpdate/Commit two-phase contract (cf. internal/raft/peer.go:58-427).
+The engine must obey the Update ordering invariants: entries_to_save must be
+fsynced before committed_entries beyond them are applied (unless fast_apply),
+and Commit(update) must be called to advance the stable/applied cursors.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..config import Config
+from ..types import (
+    EMPTY_STATE,
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+    SystemCtx,
+    Update,
+    UpdateCommit,
+    is_local_message,
+    is_response_message,
+)
+from .logentry import ILogDB
+from .raft import Raft
+
+MT = MessageType
+
+
+@dataclass
+class PeerAddress:
+    node_id: int
+    address: str
+
+
+def encode_config_change(cc: ConfigChange) -> bytes:
+    """Compact fixed codec for config change commands (the reference uses
+    protobuf; the payload is opaque to the protocol)."""
+    addr = cc.address.encode()
+    return b"%d|%d|%d|%d|%s" % (
+        cc.config_change_id,
+        int(cc.type),
+        cc.node_id,
+        1 if cc.initialize else 0,
+        addr,
+    )
+
+
+def decode_config_change(data: bytes) -> ConfigChange:
+    ccid, cctype, node_id, init, addr = data.split(b"|", 4)
+    return ConfigChange(
+        config_change_id=int(ccid),
+        type=ConfigChangeType(int(cctype)),
+        node_id=int(node_id),
+        initialize=init == b"1",
+        address=addr.decode(),
+    )
+
+
+class Peer:
+    def __init__(self, raft: Raft, prev_state: State) -> None:
+        self.raft = raft
+        self.prev_state = prev_state
+
+    # ------------------------------------------------------------- lifecycle
+    @staticmethod
+    def launch(
+        cfg: Config,
+        logdb: ILogDB,
+        events=None,
+        addresses: Optional[List[PeerAddress]] = None,
+        initial: bool = False,
+        new_node: bool = False,
+        rng: Optional[random.Random] = None,
+    ) -> "Peer":
+        addresses = addresses or []
+        _check_launch_request(cfg, addresses, initial, new_node)
+        r = Raft(cfg, logdb, events=events, rng=rng)
+        _, last_index = logdb.get_range()
+        if new_node and not cfg.is_observer and not cfg.is_witness:
+            r.become_follower(1, 0)
+        if initial and new_node:
+            _bootstrap(r, addresses)
+        prev_state = EMPTY_STATE if last_index == 0 else r.raft_state()
+        return Peer(r, prev_state)
+
+    # ------------------------------------------------------------ local ops
+    def tick(self) -> None:
+        self.raft.handle(Message(type=MT.LOCAL_TICK, reject=False))
+
+    def quiesced_tick(self) -> None:
+        self.raft.handle(Message(type=MT.LOCAL_TICK, reject=True))
+
+    def request_leader_transfer(self, target: int) -> None:
+        self.raft.handle(
+            Message(
+                type=MT.LEADER_TRANSFER,
+                to=self.raft.node_id,
+                from_=target,
+                hint=target,
+            )
+        )
+
+    def propose_entries(self, entries: List[Entry]) -> None:
+        self.raft.handle(
+            Message(type=MT.PROPOSE, from_=self.raft.node_id, entries=entries)
+        )
+
+    def propose_config_change(self, cc: ConfigChange, key: int) -> None:
+        data = encode_config_change(cc)
+        self.raft.handle(
+            Message(
+                type=MT.PROPOSE,
+                entries=[Entry(type=EntryType.CONFIG_CHANGE, cmd=data, key=key)],
+            )
+        )
+
+    def apply_config_change(self, cc: ConfigChange) -> None:
+        if cc.node_id == 0:
+            self.raft.pending_config_change = False
+            return
+        self.raft.handle(
+            Message(
+                type=MT.CONFIG_CHANGE_EVENT,
+                reject=False,
+                hint=cc.node_id,
+                hint_high=int(cc.type),
+            )
+        )
+
+    def reject_config_change(self) -> None:
+        self.raft.handle(Message(type=MT.CONFIG_CHANGE_EVENT, reject=True))
+
+    def restore_remotes(self, ss: Snapshot) -> None:
+        self.raft.handle(Message(type=MT.SNAPSHOT_RECEIVED, snapshot=ss))
+
+    def report_unreachable_node(self, node_id: int) -> None:
+        self.raft.handle(Message(type=MT.UNREACHABLE, from_=node_id))
+
+    def report_snapshot_status(self, node_id: int, reject: bool) -> None:
+        self.raft.handle(
+            Message(type=MT.SNAPSHOT_STATUS, from_=node_id, reject=reject)
+        )
+
+    def read_index(self, ctx: SystemCtx) -> None:
+        self.raft.handle(
+            Message(type=MT.READ_INDEX, hint=ctx.low, hint_high=ctx.high)
+        )
+
+    def notify_raft_last_applied(self, last_applied: int) -> None:
+        self.raft.applied = last_applied
+
+    # -------------------------------------------------------------- messages
+    def handle(self, m: Message) -> None:
+        if is_local_message(m.type):
+            raise RuntimeError("local message sent to Handle")
+        known = (
+            m.from_ in self.raft.remotes
+            or m.from_ in self.raft.observers
+            or m.from_ in self.raft.witnesses
+        )
+        if known or not is_response_message(m.type):
+            self.raft.handle(m)
+
+    # ------------------------------------------------------- update contract
+    def has_update(self, more_entries_to_apply: bool) -> bool:
+        r = self.raft
+        pst = r.raft_state()
+        if not pst.is_empty() and pst != self.prev_state:
+            return True
+        if r.log.inmem.snapshot is not None and not r.log.inmem.snapshot.is_empty():
+            return True
+        if r.msgs:
+            return True
+        if r.log.entries_to_save():
+            return True
+        if more_entries_to_apply and r.log.has_entries_to_apply():
+            return True
+        if r.ready_to_read:
+            return True
+        if r.dropped_entries or r.dropped_read_indexes:
+            return True
+        return False
+
+    def has_entry_to_apply(self) -> bool:
+        return self.raft.log.has_entries_to_apply()
+
+    def get_update(self, more_entries_to_apply: bool, last_applied: int) -> Update:
+        r = self.raft
+        ud = Update(
+            cluster_id=r.cluster_id,
+            node_id=r.node_id,
+            entries_to_save=r.log.entries_to_save(),
+            messages=r.msgs,
+            last_applied=last_applied,
+            fast_apply=True,
+        )
+        if more_entries_to_apply:
+            ud.committed_entries = r.log.entries_to_apply()
+        if ud.committed_entries:
+            ud.more_committed_entries = r.log.has_more_entries_to_apply(
+                ud.committed_entries[-1].index
+            )
+        pst = r.raft_state()
+        if pst != self.prev_state:
+            ud.state = pst
+        if r.log.inmem.snapshot is not None:
+            ud.snapshot = r.log.inmem.snapshot
+        if r.ready_to_read:
+            ud.ready_to_reads = r.ready_to_read
+        if r.dropped_entries:
+            ud.dropped_entries = r.dropped_entries
+        if r.dropped_read_indexes:
+            ud.dropped_read_indexes = r.dropped_read_indexes
+        _validate_update(ud)
+        ud = _set_fast_apply(ud)
+        ud.update_commit = get_update_commit(ud)
+        return ud
+
+    def commit(self, ud: Update) -> None:
+        r = self.raft
+        r.msgs = []
+        r.dropped_entries = []
+        r.dropped_read_indexes = []
+        if not ud.state.is_empty():
+            self.prev_state = ud.state
+        if ud.update_commit.ready_to_read > 0:
+            r.ready_to_read = []
+        r.log.commit_update(ud.update_commit)
+
+    def rate_limited(self) -> bool:
+        return False
+
+    def local_status(self):
+        r = self.raft
+        return {
+            "cluster_id": r.cluster_id,
+            "node_id": r.node_id,
+            "applied": r.applied,
+            "leader_id": r.leader_id,
+            "state": r.state,
+            "term": r.term,
+            "vote": r.vote,
+            "commit": r.log.committed,
+        }
+
+
+def launch_peer(*args, **kwargs) -> Peer:
+    return Peer.launch(*args, **kwargs)
+
+
+def _check_launch_request(
+    cfg: Config, addresses: List[PeerAddress], initial: bool, new_node: bool
+) -> None:
+    if cfg.node_id == 0:
+        raise ValueError("config.node_id must not be zero")
+    if initial and new_node and not addresses:
+        raise ValueError("addresses must be specified")
+    unique = {a.address for a in addresses}
+    if len(unique) != len(addresses):
+        raise ValueError(f"duplicated address found {addresses}")
+
+
+def _bootstrap(r: Raft, addresses: List[PeerAddress]) -> None:
+    addresses = sorted(addresses, key=lambda a: a.node_id)
+    ents = []
+    for i, peer in enumerate(addresses):
+        cc = ConfigChange(
+            type=ConfigChangeType.ADD_NODE,
+            node_id=peer.node_id,
+            initialize=True,
+            address=peer.address,
+        )
+        ents.append(
+            Entry(
+                type=EntryType.CONFIG_CHANGE,
+                term=1,
+                index=i + 1,
+                cmd=encode_config_change(cc),
+            )
+        )
+    r.log.append(ents)
+    r.log.committed = len(ents)
+    for peer in addresses:
+        r.add_node(peer.node_id)
+
+
+def _set_fast_apply(ud: Update) -> Update:
+    ud.fast_apply = True
+    if ud.snapshot is not None and not ud.snapshot.is_empty():
+        ud.fast_apply = False
+    if ud.fast_apply and ud.committed_entries and ud.entries_to_save:
+        last_apply = ud.committed_entries[-1].index
+        last_save = ud.entries_to_save[-1].index
+        first_save = ud.entries_to_save[0].index
+        if first_save <= last_apply <= last_save:
+            ud.fast_apply = False
+    return ud
+
+
+def _validate_update(ud: Update) -> None:
+    if ud.state.commit > 0 and ud.committed_entries:
+        if ud.committed_entries[-1].index > ud.state.commit:
+            raise RuntimeError("trying to apply not committed entry")
+    if ud.committed_entries and ud.entries_to_save:
+        if ud.committed_entries[-1].index > ud.entries_to_save[-1].index:
+            raise RuntimeError("trying to apply not saved entry")
+
+
+def get_update_commit(ud: Update) -> UpdateCommit:
+    uc = UpdateCommit(
+        ready_to_read=len(ud.ready_to_reads), last_applied=ud.last_applied
+    )
+    if ud.committed_entries:
+        uc.processed = ud.committed_entries[-1].index
+    if ud.entries_to_save:
+        last = ud.entries_to_save[-1]
+        uc.stable_log_to, uc.stable_log_term = last.index, last.term
+    if ud.snapshot is not None and not ud.snapshot.is_empty():
+        uc.stable_snapshot_to = ud.snapshot.index
+        uc.processed = max(uc.processed, uc.stable_snapshot_to)
+    return uc

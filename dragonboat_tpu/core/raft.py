@@ -1,0 +1,1116 @@
+"""The scalar Raft state machine: full protocol semantics for one replica.
+
+Behavior matches the reference implementation (cf. internal/raft/raft.go):
+election with randomized timeouts and disruption defense, log replication
+with per-follower flow control, quorum commit restricted to current-term
+entries (Raft paper section 5.4.2), ReadIndex (thesis section 6.4), single
+pending membership change, leadership transfer (thesis p29), check-quorum
+leader step-down (thesis p69), observers (thesis section 4.2.1) and witnesses
+(thesis section 11.7.2).
+
+This scalar form is the semantic oracle for the vectorized kernel in
+dragonboat_tpu.ops.kernel; structure here favors clarity over speed.
+"""
+from __future__ import annotations
+
+import enum
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..types import (
+    NO_LEADER,
+    NO_NODE,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+    ReadyToRead,
+    Snapshot,
+    State,
+    SystemCtx,
+    is_leader_message,
+)
+from ..config import Config
+from .. import settings
+from .logentry import EntryLog, ErrCompacted, ILogDB
+from .readindex import ReadIndexTracker
+from .remote import Remote, RemoteState
+
+MT = MessageType
+
+
+class RaftNodeState(enum.IntEnum):
+    """Replica roles; numbering matches reference raft.go:63-70."""
+
+    FOLLOWER = 0
+    CANDIDATE = 1
+    LEADER = 2
+    OBSERVER = 3
+    WITNESS = 4
+
+
+class Raft:
+    def __init__(
+        self,
+        cfg: Config,
+        logdb: ILogDB,
+        events=None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        cfg.validate()
+        self.cluster_id = cfg.cluster_id
+        self.node_id = cfg.node_id
+        self.leader_id = NO_LEADER
+        self.term = 0
+        self.vote = NO_NODE
+        self.applied = 0
+        self.log = EntryLog(logdb)
+        self.remotes: Dict[int, Remote] = {}
+        self.observers: Dict[int, Remote] = {}
+        self.witnesses: Dict[int, Remote] = {}
+        self.state = RaftNodeState.FOLLOWER
+        self.votes: Dict[int, bool] = {}
+        self.msgs: List[Message] = []
+        self.leader_transfer_target = NO_NODE
+        self.is_leader_transfer_target = False
+        self.pending_config_change = False
+        self.read_index = ReadIndexTracker()
+        self.ready_to_read: List[ReadyToRead] = []
+        self.dropped_entries: List[Entry] = []
+        self.dropped_read_indexes: List[SystemCtx] = []
+        self.quiesced = False
+        self.check_quorum = cfg.check_quorum
+        self.tick_count = 0
+        self.election_tick = 0
+        self.heartbeat_tick = 0
+        self.election_timeout = cfg.election_rtt
+        self.heartbeat_timeout = cfg.heartbeat_rtt
+        self.randomized_election_timeout = 0
+        self.max_entry_size = settings.soft.max_entry_size
+        self.events = events
+        self.rng = rng if rng is not None else random.Random()
+        # test-only hook mirroring reference raft.go:1460-1472
+        self.has_not_applied_config_change: Optional[Callable[[], bool]] = None
+
+        st, members = logdb.node_state()
+        for p in members.addresses:
+            self.remotes[p] = Remote(next=1)
+        for p in members.observers:
+            self.observers[p] = Remote(next=1)
+        for p in members.witnesses:
+            self.witnesses[p] = Remote(next=1)
+        if not st.is_empty():
+            self._load_state(st)
+        if cfg.is_observer:
+            self.state = RaftNodeState.OBSERVER
+            self.become_observer(self.term, NO_LEADER)
+        elif cfg.is_witness:
+            self.state = RaftNodeState.WITNESS
+            self.become_witness(self.term, NO_LEADER)
+        else:
+            self.become_follower(self.term, NO_LEADER)
+
+    # ------------------------------------------------------------------ util
+    def is_leader(self) -> bool:
+        return self.state == RaftNodeState.LEADER
+
+    def is_candidate(self) -> bool:
+        return self.state == RaftNodeState.CANDIDATE
+
+    def is_follower(self) -> bool:
+        return self.state == RaftNodeState.FOLLOWER
+
+    def is_observer(self) -> bool:
+        return self.state == RaftNodeState.OBSERVER
+
+    def is_witness(self) -> bool:
+        return self.state == RaftNodeState.WITNESS
+
+    def _must_be_leader(self) -> None:
+        if not self.is_leader():
+            raise RuntimeError(f"{self._describe()} is not a leader")
+
+    def _describe(self) -> str:
+        return (
+            f"[c{self.cluster_id},n{self.node_id}] t{self.term} "
+            f"{self.state.name.lower()}"
+        )
+
+    def set_leader_id(self, leader_id: int) -> None:
+        self.leader_id = leader_id
+        if self.events is not None:
+            self.events.leader_updated(
+                self.cluster_id, self.node_id, leader_id, self.term
+            )
+
+    def num_voting_members(self) -> int:
+        return len(self.remotes) + len(self.witnesses)
+
+    def quorum(self) -> int:
+        return self.num_voting_members() // 2 + 1
+
+    def is_single_node_quorum(self) -> bool:
+        return self.quorum() == 1
+
+    def voting_members(self) -> Dict[int, Remote]:
+        members = dict(self.remotes)
+        members.update(self.witnesses)
+        return members
+
+    def nodes(self) -> List[int]:
+        return (
+            list(self.remotes) + list(self.observers) + list(self.witnesses)
+        )
+
+    def leader_transfering(self) -> bool:
+        return self.leader_transfer_target != NO_NODE and self.is_leader()
+
+    def abort_leader_transfer(self) -> None:
+        self.leader_transfer_target = NO_NODE
+
+    def self_removed(self) -> bool:
+        if self.is_observer():
+            return self.node_id not in self.observers
+        if self.is_witness():
+            return self.node_id not in self.witnesses
+        return self.node_id not in self.remotes
+
+    def raft_state(self) -> State:
+        return State(term=self.term, vote=self.vote, commit=self.log.committed)
+
+    def _load_state(self, st: State) -> None:
+        if st.commit < self.log.committed or st.commit > self.log.last_index():
+            raise RuntimeError(
+                f"out of range state, commit {st.commit}, "
+                f"range [{self.log.committed},{self.log.last_index()}]"
+            )
+        self.log.committed = st.commit
+        self.term = st.term
+        self.vote = st.vote
+
+    def leader_has_quorum(self) -> bool:
+        count = 0
+        for nid, member in self.voting_members().items():
+            if nid == self.node_id or member.is_active():
+                count += 1
+                member.set_not_active()
+        return count >= self.quorum()
+
+    # ------------------------------------------------------------------ tick
+    def time_for_election(self) -> bool:
+        return self.election_tick >= self.randomized_election_timeout
+
+    def time_for_heartbeat(self) -> bool:
+        return self.heartbeat_tick >= self.heartbeat_timeout
+
+    def time_for_check_quorum(self) -> bool:
+        return self.election_tick >= self.election_timeout
+
+    def time_to_abort_leader_transfer(self) -> bool:
+        return self.leader_transfering() and self.election_tick >= self.election_timeout
+
+    def tick(self) -> None:
+        self.quiesced = False
+        self.tick_count += 1
+        if self.is_leader():
+            self._leader_tick()
+        else:
+            self._non_leader_tick()
+
+    def _non_leader_tick(self) -> None:
+        self.election_tick += 1
+        # non-voting members and witnesses never campaign (thesis 4.2.1)
+        if self.is_observer() or self.is_witness():
+            return
+        if not self.self_removed() and self.time_for_election():
+            self.election_tick = 0
+            self.handle(Message(type=MT.ELECTION, from_=self.node_id))
+
+    def _leader_tick(self) -> None:
+        self._must_be_leader()
+        self.election_tick += 1
+        abort_transfer = self.time_to_abort_leader_transfer()
+        if self.time_for_check_quorum():
+            self.election_tick = 0
+            if self.check_quorum:
+                self.handle(Message(type=MT.CHECK_QUORUM, from_=self.node_id))
+        if abort_transfer:
+            self.abort_leader_transfer()
+        self.heartbeat_tick += 1
+        if self.time_for_heartbeat():
+            self.heartbeat_tick = 0
+            self.handle(Message(type=MT.LEADER_HEARTBEAT, from_=self.node_id))
+
+    def quiesced_tick(self) -> None:
+        self.quiesced = True
+        self.election_tick += 1
+
+    def set_randomized_election_timeout(self) -> None:
+        self.randomized_election_timeout = (
+            self.election_timeout + self.rng.randrange(self.election_timeout)
+        )
+
+    # ------------------------------------------------------------------ send
+    def _send(self, m: Message) -> None:
+        m.from_ = self.node_id
+        m.cluster_id = self.cluster_id
+        # Request-routed messages (Propose/ReadIndex) and RequestVote carry
+        # their own term; everything else is stamped with the current term
+        # (cf. raft.go finalizeMessageTerm).
+        if m.type not in (MT.PROPOSE, MT.READ_INDEX) and m.term == 0:
+            if m.type != MT.REQUEST_VOTE:
+                m.term = self.term
+        self.msgs.append(m)
+
+    def _make_replicate_message(
+        self, to: int, next_idx: int, max_size: int
+    ) -> Message:
+        # Both lookups raise ErrCompacted when the follower's window has been
+        # compacted away, triggering the snapshot fallback in the caller.
+        term = self.log.term(next_idx - 1)
+        entries = self.log.entries(next_idx, max_size)
+        if entries:
+            expected = next_idx - 1 + len(entries)
+            if entries[-1].index != expected:
+                raise RuntimeError(
+                    f"expected last index {expected}, got {entries[-1].index}"
+                )
+        if to in self.witnesses:
+            entries = _make_metadata_entries(entries)
+        return Message(
+            to=to,
+            type=MT.REPLICATE,
+            log_index=next_idx - 1,
+            log_term=term,
+            entries=entries,
+            commit=self.log.committed,
+        )
+
+    def make_install_snapshot_message(self, to: int) -> Tuple[Message, int]:
+        ss = self.log.get_snapshot()
+        if ss.is_empty():
+            raise RuntimeError(f"{self._describe()} got an empty snapshot")
+        if to in self.witnesses:
+            ss = _make_witness_snapshot(ss)
+        m = Message(to=to, type=MT.INSTALL_SNAPSHOT, snapshot=ss)
+        return m, ss.index
+
+    def send_replicate_message(self, to: int) -> None:
+        rp = (
+            self.remotes.get(to)
+            or self.observers.get(to)
+            or self.witnesses.get(to)
+        )
+        if rp is None:
+            raise RuntimeError(f"{self._describe()} no remote for {to}")
+        if rp.is_paused():
+            return
+        try:
+            m = self._make_replicate_message(to, rp.next, self.max_entry_size)
+        except ErrCompacted:
+            # log compacted away: fall back to snapshot (cf. raft.go:774-785)
+            if not rp.is_active():
+                return
+            m, index = self.make_install_snapshot_message(to)
+            rp.become_snapshot(index)
+            self._send(m)
+            return
+        if m.entries:
+            rp.progress(m.entries[-1].index)
+        self._send(m)
+
+    def broadcast_replicate_message(self) -> None:
+        self._must_be_leader()
+        for nid in self.nodes():
+            if nid != self.node_id:
+                self.send_replicate_message(nid)
+
+    def send_heartbeat_message(self, to: int, hint: SystemCtx, match: int) -> None:
+        self._send(
+            Message(
+                to=to,
+                type=MT.HEARTBEAT,
+                commit=min(match, self.log.committed),
+                hint=hint.low,
+                hint_high=hint.high,
+            )
+        )
+
+    def broadcast_heartbeat_message(self, ctx: Optional[SystemCtx] = None) -> None:
+        self._must_be_leader()
+        if ctx is None:
+            if self.read_index.has_pending_request():
+                ctx = self.read_index.peep_ctx()
+            else:
+                ctx = SystemCtx()
+        for nid, rm in self.voting_members().items():
+            if nid != self.node_id:
+                self.send_heartbeat_message(nid, ctx, rm.match)
+        if ctx.is_zero():
+            for nid, rm in self.observers.items():
+                self.send_heartbeat_message(nid, ctx, rm.match)
+
+    def send_timeout_now_message(self, node_id: int) -> None:
+        self._send(Message(type=MT.TIMEOUT_NOW, to=node_id))
+
+    # ---------------------------------------------------------- commit/append
+    def try_commit(self) -> bool:
+        self._must_be_leader()
+        matched = sorted(
+            [v.match for v in self.remotes.values()]
+            + [v.match for v in self.witnesses.values()]
+        )
+        q = matched[self.num_voting_members() - self.quorum()]
+        # only current-term entries commit by counting (paper section 5.4.2)
+        return self.log.try_commit(q, self.term)
+
+    def append_entries(self, entries: List[Entry]) -> None:
+        last_index = self.log.last_index()
+        for i, e in enumerate(entries):
+            e.term = self.term
+            e.index = last_index + 1 + i
+        self.log.append(entries)
+        self.remotes[self.node_id].try_update(self.log.last_index())
+        if self.is_single_node_quorum():
+            self.try_commit()
+
+    # ------------------------------------------------------ state transitions
+    def _reset(self, term: int) -> None:
+        if self.term != term:
+            self.term = term
+            self.vote = NO_LEADER
+        self.votes = {}
+        self.election_tick = 0
+        self.heartbeat_tick = 0
+        self.set_randomized_election_timeout()
+        self.read_index = ReadIndexTracker()
+        self.pending_config_change = False
+        self.abort_leader_transfer()
+        self._reset_remotes()
+
+    def _reset_remotes(self) -> None:
+        # (cf. raft.go resetRemotes: nextIndex = last log index + 1, own match)
+        for group in (self.remotes, self.observers, self.witnesses):
+            for nid in group:
+                group[nid] = Remote(next=self.log.last_index() + 1)
+                if nid == self.node_id:
+                    group[nid].match = self.log.last_index()
+
+    def become_observer(self, term: int, leader_id: int) -> None:
+        if not self.is_observer():
+            raise RuntimeError("transitioning to observer from non-observer")
+        self._reset(term)
+        self.set_leader_id(leader_id)
+
+    def become_witness(self, term: int, leader_id: int) -> None:
+        if not self.is_witness():
+            raise RuntimeError("transitioning to witness from non-witness")
+        self._reset(term)
+        self.set_leader_id(leader_id)
+
+    def become_follower(self, term: int, leader_id: int) -> None:
+        if self.is_witness():
+            raise RuntimeError("transitioning to follower from witness")
+        self.state = RaftNodeState.FOLLOWER
+        self._reset(term)
+        self.set_leader_id(leader_id)
+
+    def become_candidate(self) -> None:
+        if self.is_leader():
+            raise RuntimeError("transitioning to candidate from leader")
+        if self.is_observer() or self.is_witness():
+            raise RuntimeError("observer/witness cannot campaign")
+        self.state = RaftNodeState.CANDIDATE
+        # paper section 5.2: increment term, vote for self
+        self._reset(self.term + 1)
+        self.set_leader_id(NO_LEADER)
+        self.vote = self.node_id
+
+    def become_leader(self) -> None:
+        if not (self.is_leader() or self.is_candidate()):
+            raise RuntimeError(f"transitioning to leader from {self.state}")
+        self.state = RaftNodeState.LEADER
+        self._reset(self.term)
+        self.set_leader_id(self.node_id)
+        self._pre_leader_promotion_handle_config_change()
+        # commit a noop entry of the new term ASAP (thesis p72)
+        self.append_entries([Entry(type=EntryType.APPLICATION)])
+
+    def _pre_leader_promotion_handle_config_change(self) -> None:
+        n = self._get_pending_config_change_count()
+        if n > 1:
+            raise RuntimeError("multiple uncommitted config change entries")
+        if n == 1:
+            self.pending_config_change = True
+
+    def _get_pending_config_change_count(self) -> int:
+        idx = self.log.committed + 1
+        count = 0
+        while True:
+            ents = self.log.entries(idx, settings.soft.max_entries_to_apply_size)
+            if not ents:
+                return count
+            count += sum(1 for e in ents if e.is_config_change())
+            idx = ents[-1].index + 1
+
+    # ------------------------------------------------------------- elections
+    def _handle_vote_resp(self, from_: int, rejected: bool) -> int:
+        if from_ not in self.votes:
+            self.votes[from_] = not rejected
+        return sum(1 for v in self.votes.values() if v)
+
+    def campaign(self) -> None:
+        self.become_candidate()
+        term = self.term
+        if self.events is not None:
+            self.events.campaign_launched(self.cluster_id, self.node_id, term)
+        self._handle_vote_resp(self.node_id, False)
+        if self.is_single_node_quorum():
+            self.become_leader()
+            return
+        hint = 0
+        if self.is_leader_transfer_target:
+            hint = self.node_id
+            self.is_leader_transfer_target = False
+        for k in self.voting_members():
+            if k == self.node_id:
+                continue
+            self._send(
+                Message(
+                    term=term,
+                    to=k,
+                    type=MT.REQUEST_VOTE,
+                    log_index=self.log.last_index(),
+                    log_term=self.log.last_term(),
+                    hint=hint,
+                )
+            )
+
+    # ------------------------------------------------------------ membership
+    def add_node(self, node_id: int) -> None:
+        self.pending_config_change = False
+        if node_id == self.node_id and self.is_witness():
+            raise RuntimeError("adding self while witness")
+        if node_id in self.remotes:
+            return
+        if node_id in self.observers:
+            # promote observer, inheriting progress
+            rp = self.observers.pop(node_id)
+            self.remotes[node_id] = rp
+            if node_id == self.node_id:
+                self.become_follower(self.term, self.leader_id)
+        elif node_id in self.witnesses:
+            raise RuntimeError("cannot promote witness to full member")
+        else:
+            self.remotes[node_id] = Remote(next=self.log.last_index() + 1)
+
+    def add_observer(self, node_id: int) -> None:
+        self.pending_config_change = False
+        if node_id == self.node_id and not self.is_observer():
+            raise RuntimeError("adding self as observer while not observer")
+        if node_id in self.observers:
+            return
+        self.observers[node_id] = Remote(next=self.log.last_index() + 1)
+
+    def add_witness(self, node_id: int) -> None:
+        self.pending_config_change = False
+        if node_id == self.node_id and not self.is_witness():
+            raise RuntimeError("adding self as witness while not witness")
+        if node_id in self.witnesses:
+            return
+        self.witnesses[node_id] = Remote(next=self.log.last_index() + 1)
+
+    def remove_node(self, node_id: int) -> None:
+        self.remotes.pop(node_id, None)
+        self.observers.pop(node_id, None)
+        self.witnesses.pop(node_id, None)
+        self.pending_config_change = False
+        if self.node_id == node_id and self.is_leader():
+            self.become_follower(self.term, NO_LEADER)
+        if self.leader_transfering() and self.leader_transfer_target == node_id:
+            self.abort_leader_transfer()
+        if self.is_leader() and self.num_voting_members() > 0:
+            if self.try_commit():
+                self.broadcast_replicate_message()
+
+    # ------------------------------------------------------------- snapshots
+    def restore(self, ss: Snapshot) -> bool:
+        if ss.index <= self.log.committed:
+            return False
+        if not self.is_observer():
+            for nid in ss.membership.observers:
+                if nid == self.node_id:
+                    raise RuntimeError("converting non-observer to observer")
+        if not self.is_witness():
+            for nid in ss.membership.witnesses:
+                if nid == self.node_id:
+                    raise RuntimeError("converting non-witness to witness")
+        # snapshot at index X implies X committed (thesis p52)
+        if self.log.match_term(ss.index, ss.term):
+            self.log.commit_to(ss.index)
+            return False
+        self.log.restore(ss)
+        return True
+
+    def restore_remotes(self, ss: Snapshot) -> None:
+        self.remotes = {}
+        for nid in ss.membership.addresses:
+            if nid == self.node_id and self.is_observer():
+                self.become_follower(self.term, self.leader_id)
+            if nid in self.witnesses:
+                raise RuntimeError("witness cannot be promoted to full member")
+            next_idx = self.log.last_index() + 1
+            match = next_idx - 1 if nid == self.node_id else 0
+            self.remotes[nid] = Remote(match=match, next=next_idx)
+        if self.self_removed() and self.is_leader():
+            self.become_follower(self.term, NO_LEADER)
+        self.observers = {}
+        for nid in ss.membership.observers:
+            next_idx = self.log.last_index() + 1
+            match = next_idx - 1 if nid == self.node_id else 0
+            self.observers[nid] = Remote(match=match, next=next_idx)
+        self.witnesses = {}
+        for nid in ss.membership.witnesses:
+            next_idx = self.log.last_index() + 1
+            match = next_idx - 1 if nid == self.node_id else 0
+            self.witnesses[nid] = Remote(match=match, next=next_idx)
+
+    # -------------------------------------------------------------- dispatch
+    def handle(self, m: Message) -> None:
+        if not self._on_message_term_not_matched(m):
+            if m.term != 0 and self.term != m.term:
+                raise RuntimeError("mismatched term found")
+            self._dispatch(m)
+
+    def _drop_request_vote_from_high_term_node(self, m: Message) -> bool:
+        # disruption defense (paper section 6 last paragraph, thesis p42)
+        if m.type != MT.REQUEST_VOTE or not self.check_quorum or m.term <= self.term:
+            return False
+        if m.hint == m.from_:
+            # leader-transfer hint: let it through
+            return False
+        if self.leader_id != NO_LEADER and self.election_tick < self.election_timeout:
+            return True
+        return False
+
+    def _on_message_term_not_matched(self, m: Message) -> bool:
+        if m.term == 0 or m.term == self.term:
+            return False
+        if self._drop_request_vote_from_high_term_node(m):
+            return True
+        if m.term > self.term:
+            leader_id = m.from_ if is_leader_message(m.type) else NO_LEADER
+            if self.is_observer():
+                self.become_observer(m.term, leader_id)
+            elif self.is_witness():
+                self.become_witness(m.term, leader_id)
+            else:
+                self.become_follower(m.term, leader_id)
+            return False
+        # m.term < self.term
+        if is_leader_message(m.type) and self.check_quorum:
+            # free a stuck higher-term candidate (etcd's
+            # TestFreeStuckCandidateWithCheckQuorum corner case)
+            self._send(Message(to=m.from_, type=MT.NOOP))
+        return True
+
+    def _dispatch(self, m: Message) -> None:
+        handler = _HANDLERS[self.state].get(m.type)
+        if handler is not None:
+            handler(self, m)
+
+    def _lookup_remote(self, from_: int) -> Optional[Remote]:
+        return (
+            self.remotes.get(from_)
+            or self.observers.get(from_)
+            or self.witnesses.get(from_)
+        )
+
+    # -------------------------------------------------- handlers (any state)
+    def _handle_node_election(self, m: Message) -> None:
+        if self.is_leader():
+            return
+        # don't campaign with a committed-but-unapplied config change
+        # (quorum may differ after it applies; cf. raft.go:1484-1508)
+        if self._has_config_change_to_apply():
+            if self.events is not None:
+                self.events.campaign_skipped(
+                    self.cluster_id, self.node_id, self.term
+                )
+            return
+        self.campaign()
+
+    def _has_config_change_to_apply(self) -> bool:
+        if self.has_not_applied_config_change is not None:
+            return self.has_not_applied_config_change()
+        # Scan the committed-but-unapplied window for config changes. The
+        # reference conservatively refuses to campaign whenever
+        # committed > applied and notes the precise scan as a TODO
+        # (raft.go:1461-1470); with entries held in memory the scan is cheap.
+        if self.log.committed <= self.applied:
+            return False
+        idx = max(self.applied + 1, self.log.first_index())
+        while idx <= self.log.committed:
+            ents = self.log.get_entries(
+                idx, self.log.committed + 1, settings.soft.max_entry_size
+            )
+            if not ents:
+                return False
+            if any(e.is_config_change() for e in ents):
+                return True
+            idx = ents[-1].index + 1
+        return False
+
+    def _can_grant_vote(self, m: Message) -> bool:
+        return self.vote in (NO_NODE, m.from_) or m.term > self.term
+
+    def _handle_node_request_vote(self, m: Message) -> None:
+        resp = Message(to=m.from_, type=MT.REQUEST_VOTE_RESP)
+        can_grant = self._can_grant_vote(m)
+        up_to_date = self.log.up_to_date(m.log_index, m.log_term)
+        if can_grant and up_to_date:
+            self.election_tick = 0
+            self.vote = m.from_
+        else:
+            resp.reject = True
+        self._send(resp)
+
+    def _handle_node_config_change(self, m: Message) -> None:
+        if m.reject:
+            self.pending_config_change = False
+            return
+        cctype = ConfigChangeType(m.hint_high)
+        node_id = m.hint
+        if cctype == ConfigChangeType.ADD_NODE:
+            self.add_node(node_id)
+        elif cctype == ConfigChangeType.REMOVE_NODE:
+            self.remove_node(node_id)
+        elif cctype == ConfigChangeType.ADD_OBSERVER:
+            self.add_observer(node_id)
+        elif cctype == ConfigChangeType.ADD_WITNESS:
+            self.add_witness(node_id)
+        else:
+            raise RuntimeError("unexpected config change type")
+
+    def _handle_local_tick(self, m: Message) -> None:
+        if m.reject:
+            self.quiesced_tick()
+        else:
+            self.tick()
+
+    def _handle_restore_remote(self, m: Message) -> None:
+        self.restore_remotes(m.snapshot)
+
+    # ------------------------------------------------------- leader handlers
+    def _handle_leader_heartbeat(self, m: Message) -> None:
+        self.broadcast_heartbeat_message()
+
+    def _handle_leader_check_quorum(self, m: Message) -> None:
+        self._must_be_leader()
+        if not self.leader_has_quorum():
+            self.become_follower(self.term, NO_LEADER)
+
+    def _handle_leader_propose(self, m: Message) -> None:
+        self._must_be_leader()
+        if self.leader_transfering():
+            self._report_dropped_proposal(m)
+            return
+        for i, e in enumerate(m.entries):
+            if e.type == EntryType.CONFIG_CHANGE:
+                if self.pending_config_change:
+                    self._report_dropped_config_change(m.entries[i])
+                    m.entries[i] = Entry(type=EntryType.APPLICATION)
+                else:
+                    self.pending_config_change = True
+        self.append_entries(m.entries)
+        self.broadcast_replicate_message()
+
+    def _has_committed_entry_at_current_term(self) -> bool:
+        if self.term == 0:
+            raise RuntimeError("term is 0")
+        try:
+            last_committed_term = self.log.term(self.log.committed)
+        except ErrCompacted:
+            last_committed_term = 0
+        return last_committed_term == self.term
+
+    def _add_ready_to_read(self, index: int, ctx: SystemCtx) -> None:
+        self.ready_to_read.append(ReadyToRead(index=index, system_ctx=ctx))
+
+    def _handle_leader_read_index(self, m: Message) -> None:
+        self._must_be_leader()
+        ctx = SystemCtx(low=m.hint, high=m.hint_high)
+        if not self.is_single_node_quorum():
+            if not self._has_committed_entry_at_current_term():
+                # thesis section 6.4 step 1: leader must have committed an
+                # entry at its current term first
+                self._report_dropped_read_index(m)
+                return
+            self.read_index.add_request(self.log.committed, ctx, m.from_)
+            self.broadcast_heartbeat_message(ctx)
+        else:
+            self._add_ready_to_read(self.log.committed, ctx)
+            if m.from_ != self.node_id and (
+                m.from_ in self.observers or m.from_ in self.witnesses
+            ):
+                self._send(
+                    Message(
+                        to=m.from_,
+                        type=MT.READ_INDEX_RESP,
+                        log_index=self.log.committed,
+                        hint=m.hint,
+                        hint_high=m.hint_high,
+                        commit=m.commit,
+                    )
+                )
+
+    def _handle_leader_replicate_resp(self, m: Message, rp: Remote) -> None:
+        self._must_be_leader()
+        rp.set_active()
+        if not m.reject:
+            paused = rp.is_paused()
+            if rp.try_update(m.log_index):
+                rp.responded_to()
+                if self.try_commit():
+                    self.broadcast_replicate_message()
+                elif paused:
+                    self.send_replicate_message(m.from_)
+                # leadership transfer (thesis p29): target caught up => go
+                if (
+                    self.leader_transfering()
+                    and m.from_ == self.leader_transfer_target
+                    and self.log.last_index() == rp.match
+                ):
+                    self.send_timeout_now_message(self.leader_transfer_target)
+        else:
+            if rp.decrease_to(m.log_index, m.hint):
+                if rp.state == RemoteState.REPLICATE:
+                    rp.become_retry()
+                self.send_replicate_message(m.from_)
+
+    def _handle_leader_heartbeat_resp(self, m: Message, rp: Remote) -> None:
+        self._must_be_leader()
+        rp.set_active()
+        rp.wait_to_retry()
+        if rp.match < self.log.last_index():
+            self.send_replicate_message(m.from_)
+        if m.hint != 0:
+            self._handle_read_index_leader_confirmation(m)
+
+    def _handle_read_index_leader_confirmation(self, m: Message) -> None:
+        ctx = SystemCtx(low=m.hint, high=m.hint_high)
+        ready = self.read_index.confirm(ctx, m.from_, self.quorum())
+        for s in ready or []:
+            if s.from_ in (NO_NODE, self.node_id):
+                self._add_ready_to_read(s.index, s.ctx)
+            else:
+                self._send(
+                    Message(
+                        to=s.from_,
+                        type=MT.READ_INDEX_RESP,
+                        log_index=s.index,
+                        hint=m.hint,
+                        hint_high=m.hint_high,
+                    )
+                )
+
+    def _handle_leader_transfer(self, m: Message, rp: Remote) -> None:
+        self._must_be_leader()
+        target = m.hint
+        if target == NO_NODE:
+            raise RuntimeError("leader transfer target not set")
+        if self.leader_transfering():
+            return
+        if self.node_id == target:
+            return
+        self.leader_transfer_target = target
+        self.election_tick = 0
+        if rp.match == self.log.last_index():
+            self.send_timeout_now_message(target)
+
+    def _handle_leader_snapshot_status(self, m: Message, rp: Remote) -> None:
+        if rp.state != RemoteState.SNAPSHOT:
+            return
+        if m.reject:
+            rp.clear_pending_snapshot()
+        rp.become_wait()
+
+    def _handle_leader_unreachable(self, m: Message, rp: Remote) -> None:
+        if rp.state == RemoteState.REPLICATE:
+            rp.become_retry()
+
+    def _handle_leader_rate_limit(self, m: Message) -> None:
+        # Rate limiting is host-side in the TPU build; tracked per follower by
+        # the engine (cf. raft.go handleLeaderRateLimit).
+        pass
+
+    # ----------------------------------------------------- follower handlers
+    def _handle_follower_propose(self, m: Message) -> None:
+        if self.leader_id == NO_LEADER:
+            self._report_dropped_proposal(m)
+            return
+        fwd = Message(
+            type=MT.PROPOSE,
+            to=self.leader_id,
+            entries=list(m.entries),
+        )
+        self._send(fwd)
+
+    def _leader_is_available(self) -> None:
+        self.election_tick = 0
+
+    def _handle_follower_replicate(self, m: Message) -> None:
+        self._leader_is_available()
+        self.set_leader_id(m.from_)
+        self._handle_replicate_message(m)
+
+    def _handle_follower_heartbeat(self, m: Message) -> None:
+        self._leader_is_available()
+        self.set_leader_id(m.from_)
+        self._handle_heartbeat_message(m)
+
+    def _handle_follower_read_index(self, m: Message) -> None:
+        if self.leader_id == NO_LEADER:
+            self._report_dropped_read_index(m)
+            return
+        fwd = Message(
+            type=MT.READ_INDEX,
+            to=self.leader_id,
+            hint=m.hint,
+            hint_high=m.hint_high,
+        )
+        self._send(fwd)
+
+    def _handle_follower_leader_transfer(self, m: Message) -> None:
+        if self.leader_id == NO_LEADER:
+            return
+        self._send(
+            Message(type=MT.LEADER_TRANSFER, to=self.leader_id, hint=m.hint)
+        )
+
+    def _handle_follower_read_index_resp(self, m: Message) -> None:
+        self._leader_is_available()
+        self.set_leader_id(m.from_)
+        self._add_ready_to_read(
+            m.log_index, SystemCtx(low=m.hint, high=m.hint_high)
+        )
+
+    def _handle_follower_install_snapshot(self, m: Message) -> None:
+        self._leader_is_available()
+        self.set_leader_id(m.from_)
+        self._handle_install_snapshot_message(m)
+
+    def _handle_follower_timeout_now(self, m: Message) -> None:
+        # transfer fast path: behave as if the election timer fired (thesis p29)
+        self.election_tick = self.randomized_election_timeout
+        self.is_leader_transfer_target = True
+        self.tick()
+        self.is_leader_transfer_target = False
+
+    # ---------------------------------------------------- candidate handlers
+    def _handle_candidate_propose(self, m: Message) -> None:
+        self._report_dropped_proposal(m)
+
+    def _handle_candidate_read_index(self, m: Message) -> None:
+        self._report_dropped_read_index(m)
+
+    def _handle_candidate_replicate(self, m: Message) -> None:
+        # a Replicate at our term implies an established leader (paper 5.2)
+        self.become_follower(self.term, m.from_)
+        self._handle_replicate_message(m)
+
+    def _handle_candidate_install_snapshot(self, m: Message) -> None:
+        self.become_follower(self.term, m.from_)
+        self._handle_install_snapshot_message(m)
+
+    def _handle_candidate_heartbeat(self, m: Message) -> None:
+        self.become_follower(self.term, m.from_)
+        self._handle_heartbeat_message(m)
+
+    def _handle_candidate_request_vote_resp(self, m: Message) -> None:
+        if m.from_ in self.observers:
+            return
+        count = self._handle_vote_resp(m.from_, m.reject)
+        if count == self.quorum():
+            self.become_leader()
+            self.broadcast_replicate_message()
+        elif len(self.votes) - count == self.quorum():
+            # all hope lost for this term (etcd behavior)
+            self.become_follower(self.term, NO_LEADER)
+
+    # ----------------------------------------------------- message mechanics
+    def _handle_replicate_message(self, m: Message) -> None:
+        resp = Message(to=m.from_, type=MT.REPLICATE_RESP)
+        if m.log_index < self.log.committed:
+            resp.log_index = self.log.committed
+            self._send(resp)
+            return
+        if self.log.match_term(m.log_index, m.log_term):
+            self.log.try_append(m.log_index, m.entries)
+            last_idx = m.log_index + len(m.entries)
+            self.log.commit_to(min(last_idx, m.commit))
+            resp.log_index = last_idx
+        else:
+            resp.reject = True
+            resp.log_index = m.log_index
+            resp.hint = self.log.last_index()
+            if self.events is not None:
+                self.events.replication_rejected(
+                    self.cluster_id, self.node_id, m.log_index, m.log_term, m.from_
+                )
+        self._send(resp)
+
+    def _handle_heartbeat_message(self, m: Message) -> None:
+        self.log.commit_to(m.commit)
+        self._send(
+            Message(
+                to=m.from_,
+                type=MT.HEARTBEAT_RESP,
+                hint=m.hint,
+                hint_high=m.hint_high,
+            )
+        )
+
+    def _handle_install_snapshot_message(self, m: Message) -> None:
+        resp = Message(to=m.from_, type=MT.REPLICATE_RESP)
+        if self.restore(m.snapshot):
+            resp.log_index = self.log.last_index()
+        else:
+            resp.log_index = self.log.committed
+            if self.events is not None:
+                self.events.snapshot_rejected(
+                    self.cluster_id,
+                    self.node_id,
+                    m.snapshot.index,
+                    m.snapshot.term,
+                    m.from_,
+                )
+        self._send(resp)
+
+    # --------------------------------------------------------------- reports
+    def _report_dropped_proposal(self, m: Message) -> None:
+        self.dropped_entries.extend(m.entries)
+        if self.events is not None:
+            self.events.proposal_dropped(self.cluster_id, self.node_id, m.entries)
+
+    def _report_dropped_config_change(self, e: Entry) -> None:
+        self.dropped_entries.append(e)
+
+    def _report_dropped_read_index(self, m: Message) -> None:
+        self.dropped_read_indexes.append(SystemCtx(low=m.hint, high=m.hint_high))
+        if self.events is not None:
+            self.events.read_index_dropped(self.cluster_id, self.node_id)
+
+
+def _make_metadata_entries(entries: List[Entry]) -> List[Entry]:
+    """Witnesses receive metadata-only entries except config changes
+    (cf. raft.go:742-756)."""
+    out = []
+    for e in entries:
+        if e.type != EntryType.CONFIG_CHANGE:
+            out.append(Entry(type=EntryType.METADATA, index=e.index, term=e.term))
+        else:
+            out.append(e)
+    return out
+
+
+def _make_witness_snapshot(ss: Snapshot) -> Snapshot:
+    """Witness replicas get a real (non-dummy) snapshot record with the data
+    payload stripped (cf. raft.go:699-707)."""
+    return Snapshot(
+        filepath="",
+        file_size=0,
+        index=ss.index,
+        term=ss.term,
+        membership=ss.membership,
+        files=[],
+        checksum=ss.checksum,
+        dummy=False,
+        cluster_id=ss.cluster_id,
+        witness=True,
+    )
+
+
+def _lw(f):
+    """Wrap a leader handler that needs the sender's Remote
+    (cf. raft.go lw())."""
+
+    def wrapped(r: Raft, m: Message) -> None:
+        rp = r._lookup_remote(m.from_)
+        if rp is None:
+            return
+        f(r, m, rp)
+
+    return wrapped
+
+
+# Handler table [state][message type] mirroring reference raft.go:2037-2098;
+# messages with no handler for the current state are silently dropped.
+_HANDLERS: Dict[RaftNodeState, Dict[MessageType, Callable]] = {
+    RaftNodeState.CANDIDATE: {
+        MT.HEARTBEAT: Raft._handle_candidate_heartbeat,
+        MT.PROPOSE: Raft._handle_candidate_propose,
+        MT.READ_INDEX: Raft._handle_candidate_read_index,
+        MT.REPLICATE: Raft._handle_candidate_replicate,
+        MT.INSTALL_SNAPSHOT: Raft._handle_candidate_install_snapshot,
+        MT.REQUEST_VOTE_RESP: Raft._handle_candidate_request_vote_resp,
+        MT.ELECTION: Raft._handle_node_election,
+        MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+    },
+    RaftNodeState.FOLLOWER: {
+        MT.PROPOSE: Raft._handle_follower_propose,
+        MT.REPLICATE: Raft._handle_follower_replicate,
+        MT.HEARTBEAT: Raft._handle_follower_heartbeat,
+        MT.READ_INDEX: Raft._handle_follower_read_index,
+        MT.LEADER_TRANSFER: Raft._handle_follower_leader_transfer,
+        MT.READ_INDEX_RESP: Raft._handle_follower_read_index_resp,
+        MT.INSTALL_SNAPSHOT: Raft._handle_follower_install_snapshot,
+        MT.ELECTION: Raft._handle_node_election,
+        MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.TIMEOUT_NOW: Raft._handle_follower_timeout_now,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+    },
+    RaftNodeState.LEADER: {
+        MT.LEADER_HEARTBEAT: Raft._handle_leader_heartbeat,
+        MT.CHECK_QUORUM: Raft._handle_leader_check_quorum,
+        MT.PROPOSE: Raft._handle_leader_propose,
+        MT.READ_INDEX: Raft._handle_leader_read_index,
+        MT.REPLICATE_RESP: _lw(Raft._handle_leader_replicate_resp),
+        MT.HEARTBEAT_RESP: _lw(Raft._handle_leader_heartbeat_resp),
+        MT.SNAPSHOT_STATUS: _lw(Raft._handle_leader_snapshot_status),
+        MT.UNREACHABLE: _lw(Raft._handle_leader_unreachable),
+        MT.LEADER_TRANSFER: _lw(Raft._handle_leader_transfer),
+        MT.ELECTION: Raft._handle_node_election,
+        MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+        MT.RATE_LIMIT: Raft._handle_leader_rate_limit,
+    },
+    RaftNodeState.OBSERVER: {
+        MT.HEARTBEAT: Raft._handle_follower_heartbeat,
+        MT.REPLICATE: Raft._handle_follower_replicate,
+        MT.INSTALL_SNAPSHOT: Raft._handle_follower_install_snapshot,
+        MT.PROPOSE: Raft._handle_follower_propose,
+        MT.READ_INDEX: Raft._handle_follower_read_index,
+        MT.READ_INDEX_RESP: Raft._handle_follower_read_index_resp,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+    },
+    RaftNodeState.WITNESS: {
+        MT.HEARTBEAT: Raft._handle_follower_heartbeat,
+        MT.REPLICATE: Raft._handle_follower_replicate,
+        MT.INSTALL_SNAPSHOT: Raft._handle_follower_install_snapshot,
+        MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+    },
+}

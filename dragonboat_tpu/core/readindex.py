@@ -1,0 +1,75 @@
+"""ReadIndex protocol bookkeeping (Raft thesis section 6.4).
+
+Tracks pending read contexts in FIFO order; when the quorum of heartbeat
+acknowledgements for a context arrives, that context and everything queued
+before it become ready (cf. internal/raft/readindex.go:31-116).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..types import SystemCtx
+
+
+@dataclass(slots=True)
+class ReadStatus:
+    index: int
+    from_: int
+    ctx: SystemCtx
+    confirmed: Set[int] = field(default_factory=set)
+
+
+class ReadIndexTracker:
+    def __init__(self) -> None:
+        self.pending: Dict[Tuple[int, int], ReadStatus] = {}
+        self.queue: List[Tuple[int, int]] = []
+
+    @staticmethod
+    def _key(ctx: SystemCtx) -> Tuple[int, int]:
+        return (ctx.low, ctx.high)
+
+    def add_request(self, index: int, ctx: SystemCtx, from_: int) -> None:
+        key = self._key(ctx)
+        if key in self.pending:
+            return
+        if self.queue:
+            last = self.pending[self.queue[-1]]
+            if index < last.index:
+                raise RuntimeError(
+                    f"index moved backward in readIndex, {index}:{last.index}"
+                )
+        self.queue.append(key)
+        self.pending[key] = ReadStatus(index=index, from_=from_, ctx=ctx)
+
+    def has_pending_request(self) -> bool:
+        return bool(self.queue)
+
+    def peep_ctx(self) -> SystemCtx:
+        return self.pending[self.queue[-1]].ctx
+
+    def confirm(
+        self, ctx: SystemCtx, from_: int, quorum: int
+    ) -> Optional[List[ReadStatus]]:
+        key = self._key(ctx)
+        status = self.pending.get(key)
+        if status is None:
+            return None
+        status.confirmed.add(from_)
+        # +1 accounts for the leader itself.
+        if len(status.confirmed) + 1 < quorum:
+            return None
+        ready: List[ReadStatus] = []
+        for i, pkey in enumerate(self.queue):
+            s = self.pending[pkey]
+            ready.append(s)
+            if pkey == key:
+                # Everything queued at or before the confirmed ctx reads at the
+                # confirmed index (indexes are monotone along the queue).
+                for v in ready:
+                    v.index = s.index
+                self.queue = self.queue[i + 1 :]
+                for v in ready:
+                    del self.pending[self._key(v.ctx)]
+                return ready
+        return None

@@ -1,0 +1,111 @@
+"""Per-follower replication progress and flow-control FSM.
+
+Semantics follow the reference's remote states Retry/Wait/Replicate/Snapshot
+(cf. internal/raft/remote.go:44-198). The vectorized kernel keeps the same FSM
+as an int8 tensor lane per (group, peer).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class RemoteState(enum.IntEnum):
+    RETRY = 0
+    WAIT = 1
+    REPLICATE = 2
+    SNAPSHOT = 3
+
+
+@dataclass(slots=True)
+class Remote:
+    match: int = 0
+    next: int = 0
+    snapshot_index: int = 0
+    state: RemoteState = RemoteState.RETRY
+    active: bool = False
+
+    def become_retry(self) -> None:
+        if self.state == RemoteState.SNAPSHOT:
+            self.next = max(self.match + 1, self.snapshot_index + 1)
+        else:
+            self.next = self.match + 1
+        self.snapshot_index = 0
+        self.state = RemoteState.RETRY
+
+    def retry_to_wait(self) -> None:
+        if self.state == RemoteState.RETRY:
+            self.state = RemoteState.WAIT
+
+    def wait_to_retry(self) -> None:
+        if self.state == RemoteState.WAIT:
+            self.state = RemoteState.RETRY
+
+    def become_wait(self) -> None:
+        self.become_retry()
+        self.retry_to_wait()
+
+    def become_replicate(self) -> None:
+        self.next = self.match + 1
+        self.snapshot_index = 0
+        self.state = RemoteState.REPLICATE
+
+    def become_snapshot(self, index: int) -> None:
+        self.snapshot_index = index
+        self.state = RemoteState.SNAPSHOT
+
+    def clear_pending_snapshot(self) -> None:
+        self.snapshot_index = 0
+
+    def try_update(self, index: int) -> bool:
+        """Advance match/next on a successful ReplicateResp; returns True when
+        match actually moved forward (stale responses return False)."""
+        if self.next < index + 1:
+            self.next = index + 1
+        if self.match < index:
+            self.wait_to_retry()
+            self.match = index
+            return True
+        return False
+
+    def progress(self, last_index: int) -> None:
+        """Optimistically bump next after sending entries (pipelining)."""
+        if self.state == RemoteState.REPLICATE:
+            self.next = last_index + 1
+        elif self.state == RemoteState.RETRY:
+            self.retry_to_wait()
+        else:
+            raise RuntimeError(f"unexpected remote state {self.state}")
+
+    def responded_to(self) -> None:
+        if self.state == RemoteState.RETRY:
+            self.become_replicate()
+        elif self.state == RemoteState.SNAPSHOT:
+            if self.match >= self.snapshot_index:
+                self.become_retry()
+
+    def decrease_to(self, rejected: int, last: int) -> bool:
+        """Handle a rejected ReplicateResp; conservative reset of next
+        (cf. remote.go:155-171). Returns False for stale rejections."""
+        if self.state == RemoteState.REPLICATE:
+            if rejected <= self.match:
+                return False
+            self.next = self.match + 1
+            return True
+        if self.next - 1 != rejected:
+            return False
+        self.wait_to_retry()
+        self.next = max(1, min(rejected, last + 1))
+        return True
+
+    def is_paused(self) -> bool:
+        return self.state in (RemoteState.WAIT, RemoteState.SNAPSHOT)
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def set_active(self) -> None:
+        self.active = True
+
+    def set_not_active(self) -> None:
+        self.active = False

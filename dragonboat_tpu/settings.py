@@ -1,0 +1,69 @@
+"""Compile-time-ish tunables, the equivalent of internal/settings Hard/Soft
+(cf. internal/settings/hard.go:36-99, internal/settings/soft.go:54-230).
+
+JSON overwrite files `dragonboat-tpu-hard-settings.json` and
+`dragonboat-tpu-soft-settings.json` in the working directory can override any
+field, mirroring the reference's overwrite mechanism
+(internal/settings/overwrite.go).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+
+@dataclass
+class HardSettings:
+    """Values that must never change once data has been written to disk."""
+
+    step_engine_worker_count: int = 16
+    logdb_pool_size: int = 16
+    lru_max_session_count: int = 4096
+    logdb_entry_batch_size: int = 8
+
+
+@dataclass
+class SoftSettings:
+    """Performance tunables safe to change between runs."""
+
+    max_entry_size: int = 64 * 1024 * 1024
+    in_mem_entry_slice_size: int = 512
+    min_entry_slice_free_size: int = 96
+    in_mem_gc_timeout: int = 100
+    max_proposal_payload_size: int = 32 * 1024 * 1024
+    max_message_batch_size: int = 64 * 1024 * 1024
+    incoming_proposal_queue_length: int = 2048
+    incoming_read_index_queue_length: int = 4096
+    received_message_queue_length: int = 1024
+    snapshot_status_push_delay_ms: int = 1000
+    step_engine_task_worker_count: int = 16
+    step_engine_snapshot_worker_count: int = 64
+    max_concurrent_streaming_snapshots: int = 128
+    sent_snapshot_chunk_size: int = 2 * 1024 * 1024
+    snapshot_gc_tick: int = 30
+    snapshot_chunk_timeout_tick: int = 900
+    batched_entry_apply: bool = True
+    max_entries_to_apply_size: int = 8 * 1024 * 1024
+    node_ready_chan_capacity: int = 128
+    unreachable_queue_length: int = 2048
+    latency_sample_ratio: int = 0
+    # TPU engine: ms between host driver loop iterations when idle.
+    engine_idle_sleep_ms: float = 0.2
+
+
+def _load_overrides(obj, filename: str):
+    if os.path.exists(filename):
+        try:
+            with open(filename) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return obj
+        for fld in fields(obj):
+            if fld.name in data:
+                setattr(obj, fld.name, data[fld.name])
+    return obj
+
+
+hard = _load_overrides(HardSettings(), "dragonboat-tpu-hard-settings.json")
+soft = _load_overrides(SoftSettings(), "dragonboat-tpu-soft-settings.json")

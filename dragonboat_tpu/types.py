@@ -1,0 +1,476 @@
+"""Wire and protocol state types for dragonboat-tpu.
+
+This is the raftpb-equivalent layer (cf. reference raftpb/raft.pb.go:26-51 for
+message types, raftpb/raft.go:44-110 for the non-pb runtime types). Unlike the
+reference there is no protobuf dependency: these are plain Python dataclasses
+with a compact binary codec (see codec.py) used by the transport and logdb.
+
+Protocol-state integers (term, index, node ids) are uint64 in the reference;
+the scalar oracle keeps them as Python ints, while the vectorized kernel keeps
+them as int32 device tensors (indices/terms stay well below 2**31 in any
+realistic deployment window; the kernel rebases indices against the compaction
+watermark to keep them small).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence, Tuple
+
+NO_LEADER = 0
+NO_NODE = 0
+NO_LIMIT = 2**63 - 1
+
+
+class MessageType(enum.IntEnum):
+    """Message types; numbering matches reference raftpb/raft.pb.go:26-51 so
+    that traces are comparable against the reference."""
+
+    LOCAL_TICK = 0
+    ELECTION = 1
+    LEADER_HEARTBEAT = 2
+    CONFIG_CHANGE_EVENT = 3
+    NOOP = 4
+    PING = 5
+    PONG = 6
+    PROPOSE = 7
+    SNAPSHOT_STATUS = 8
+    UNREACHABLE = 9
+    CHECK_QUORUM = 10
+    BATCHED_READ_INDEX = 11
+    REPLICATE = 12
+    REPLICATE_RESP = 13
+    REQUEST_VOTE = 14
+    REQUEST_VOTE_RESP = 15
+    INSTALL_SNAPSHOT = 16
+    HEARTBEAT = 17
+    HEARTBEAT_RESP = 18
+    READ_INDEX = 19
+    READ_INDEX_RESP = 20
+    QUIESCE = 21
+    SNAPSHOT_RECEIVED = 22
+    LEADER_TRANSFER = 23
+    TIMEOUT_NOW = 24
+    RATE_LIMIT = 25
+
+
+NUM_MESSAGE_TYPES = 26
+
+# Message types generated locally and never put on the wire
+# (cf. raftpb/raft.go IsLocalMessageType).
+_LOCAL_TYPES = frozenset(
+    {
+        MessageType.LOCAL_TICK,
+        MessageType.ELECTION,
+        MessageType.LEADER_HEARTBEAT,
+        MessageType.CONFIG_CHANGE_EVENT,
+        MessageType.CHECK_QUORUM,
+        MessageType.BATCHED_READ_INDEX,
+        MessageType.SNAPSHOT_RECEIVED,
+        MessageType.RATE_LIMIT,
+    }
+)
+
+_RESPONSE_TYPES = frozenset(
+    {
+        MessageType.REPLICATE_RESP,
+        MessageType.REQUEST_VOTE_RESP,
+        MessageType.HEARTBEAT_RESP,
+        MessageType.READ_INDEX_RESP,
+        MessageType.UNREACHABLE,
+        MessageType.SNAPSHOT_STATUS,
+        MessageType.LEADER_TRANSFER,
+    }
+)
+
+_REQUEST_TYPES = frozenset({MessageType.PROPOSE, MessageType.READ_INDEX})
+
+# Messages only a leader sends (cf. internal/raft/raft.go:1382-1385).
+_LEADER_TYPES = frozenset(
+    {
+        MessageType.REPLICATE,
+        MessageType.INSTALL_SNAPSHOT,
+        MessageType.HEARTBEAT,
+        MessageType.TIMEOUT_NOW,
+        MessageType.READ_INDEX_RESP,
+    }
+)
+
+
+def is_local_message(t: MessageType) -> bool:
+    return t in _LOCAL_TYPES
+
+
+def is_response_message(t: MessageType) -> bool:
+    return t in _RESPONSE_TYPES
+
+
+def is_request_message(t: MessageType) -> bool:
+    return t in _REQUEST_TYPES
+
+
+def is_leader_message(t: MessageType) -> bool:
+    return t in _LEADER_TYPES
+
+
+class EntryType(enum.IntEnum):
+    APPLICATION = 0
+    CONFIG_CHANGE = 1
+    # Witness replicas receive metadata-only entries (cf. raft.go:742-756).
+    METADATA = 2
+
+
+class ConfigChangeType(enum.IntEnum):
+    ADD_NODE = 0
+    REMOVE_NODE = 1
+    ADD_OBSERVER = 2
+    ADD_WITNESS = 3
+
+
+class CompressionType(enum.IntEnum):
+    NO_COMPRESSION = 0
+    SNAPPY = 1
+
+
+@dataclass(slots=True)
+class Entry:
+    """A Raft log entry (cf. raftpb raft.pb.go:589 Entry fields)."""
+
+    type: EntryType = EntryType.APPLICATION
+    term: int = 0
+    index: int = 0
+    key: int = 0
+    client_id: int = 0
+    series_id: int = 0
+    responded_to: int = 0
+    cmd: bytes = b""
+
+    def is_config_change(self) -> bool:
+        return self.type == EntryType.CONFIG_CHANGE
+
+    def is_noop_session(self) -> bool:
+        return self.client_id == NOOP_CLIENT_ID
+
+    def is_new_session_request(self) -> bool:
+        return (
+            self.type != EntryType.CONFIG_CHANGE
+            and self.client_id != NOOP_CLIENT_ID
+            and self.series_id == SERIES_ID_FOR_REGISTER
+        )
+
+    def is_end_of_session_request(self) -> bool:
+        return (
+            self.type != EntryType.CONFIG_CHANGE
+            and self.client_id != NOOP_CLIENT_ID
+            and self.series_id == SERIES_ID_FOR_UNREGISTER
+        )
+
+    def is_session_managed(self) -> bool:
+        return not (
+            self.type == EntryType.CONFIG_CHANGE or self.client_id == NOOP_CLIENT_ID
+        )
+
+    def is_update(self) -> bool:
+        """A regular session-managed update proposal."""
+        return (
+            self.type != EntryType.CONFIG_CHANGE
+            and self.client_id != NOOP_CLIENT_ID
+            and self.series_id != SERIES_ID_FOR_REGISTER
+            and self.series_id != SERIES_ID_FOR_UNREGISTER
+        )
+
+    def is_empty(self) -> bool:
+        # config-change and session-managed entries are never "empty"
+        # (cf. raftpb/raft.go:152-160)
+        if self.type == EntryType.CONFIG_CHANGE or self.is_session_managed():
+            return False
+        return len(self.cmd) == 0
+
+
+# Special client session series ids (cf. client/session.go:23-43).
+NOOP_CLIENT_ID = 0
+NOOP_SERIES_ID = 0
+SERIES_ID_FOR_REGISTER = 2**64 - 1
+SERIES_ID_FOR_UNREGISTER = 2**64 - 2
+SERIES_ID_FIRST_PROPOSAL = 1
+
+
+@dataclass(slots=True)
+class ConfigChange:
+    config_change_id: int = 0
+    type: ConfigChangeType = ConfigChangeType.ADD_NODE
+    node_id: int = 0
+    address: str = ""
+    initialize: bool = False
+
+
+@dataclass(slots=True)
+class SnapshotFile:
+    filepath: str = ""
+    file_size: int = 0
+    file_id: int = 0
+    metadata: bytes = b""
+
+
+@dataclass(slots=True)
+class Membership:
+    config_change_id: int = 0
+    addresses: dict = field(default_factory=dict)  # node_id -> address
+    removed: dict = field(default_factory=dict)  # node_id -> True
+    observers: dict = field(default_factory=dict)
+    witnesses: dict = field(default_factory=dict)
+
+    def copy(self) -> "Membership":
+        return Membership(
+            config_change_id=self.config_change_id,
+            addresses=dict(self.addresses),
+            removed=dict(self.removed),
+            observers=dict(self.observers),
+            witnesses=dict(self.witnesses),
+        )
+
+
+@dataclass(slots=True)
+class Snapshot:
+    """Snapshot metadata (cf. raftpb raft.pb.go:879)."""
+
+    filepath: str = ""
+    file_size: int = 0
+    index: int = 0
+    term: int = 0
+    membership: Optional[Membership] = None
+    files: List[SnapshotFile] = field(default_factory=list)
+    checksum: bytes = b""
+    dummy: bool = False
+    cluster_id: int = 0
+    type: int = 0
+    imported: bool = False
+    on_disk_index: int = 0
+    witness: bool = False
+
+    def is_empty(self) -> bool:
+        return self.index == 0
+
+
+@dataclass(slots=True)
+class State:
+    """Persistent Raft state (term/vote/commit), cf. raftpb raft.pb.go:529."""
+
+    term: int = 0
+    vote: int = 0
+    commit: int = 0
+
+    def is_empty(self) -> bool:
+        return self.term == 0 and self.vote == 0 and self.commit == 0
+
+
+EMPTY_STATE = State()
+
+
+@dataclass(slots=True)
+class SystemCtx:
+    """Opaque 128-bit context id used by the ReadIndex protocol
+    (cf. raftpb/raft.go SystemCtx)."""
+
+    low: int = 0
+    high: int = 0
+
+    def __hash__(self):
+        return hash((self.low, self.high))
+
+    def is_zero(self) -> bool:
+        return self.low == 0 and self.high == 0
+
+
+@dataclass(slots=True)
+class ReadyToRead:
+    index: int = 0
+    system_ctx: SystemCtx = field(default_factory=SystemCtx)
+
+
+@dataclass(slots=True)
+class Message:
+    """Raft protocol message (cf. raftpb raft.pb.go:1019-1033)."""
+
+    type: MessageType = MessageType.NOOP
+    to: int = 0
+    from_: int = 0
+    cluster_id: int = 0
+    term: int = 0
+    log_term: int = 0
+    log_index: int = 0
+    commit: int = 0
+    reject: bool = False
+    hint: int = 0
+    hint_high: int = 0
+    entries: List[Entry] = field(default_factory=list)
+    snapshot: Optional[Snapshot] = None
+
+
+@dataclass(slots=True)
+class MessageBatch:
+    requests: List[Message] = field(default_factory=list)
+    deployment_id: int = 0
+    source_address: str = ""
+    bin_ver: int = 0
+
+
+@dataclass(slots=True)
+class SnapshotChunk:
+    cluster_id: int = 0
+    node_id: int = 0
+    from_: int = 0
+    chunk_id: int = 0
+    chunk_size: int = 0
+    chunk_count: int = 0
+    data: bytes = b""
+    index: int = 0
+    term: int = 0
+    filepath: str = ""
+    file_size: int = 0
+    deployment_id: int = 0
+    file_chunk_id: int = 0
+    file_chunk_count: int = 0
+    has_file_info: bool = False
+    file_info: Optional[SnapshotFile] = None
+    membership: Optional[Membership] = None
+    bin_ver: int = 0
+    on_disk_index: int = 0
+    witness: bool = False
+
+
+@dataclass(slots=True)
+class UpdateCommit:
+    """Cursors confirming how much of an Update was processed
+    (cf. raftpb/raft.go UpdateCommit and peer.go getUpdateCommit)."""
+
+    processed: int = 0
+    last_applied: int = 0
+    stable_log_to: int = 0
+    stable_log_term: int = 0
+    stable_snapshot_to: int = 0
+    ready_to_read: int = 0
+
+
+@dataclass(slots=True)
+class Update:
+    """The per-step output of a Raft node: what to persist, send, and apply
+    (cf. raftpb/raft.go Update)."""
+
+    cluster_id: int = 0
+    node_id: int = 0
+    state: State = field(default_factory=State)
+    entries_to_save: List[Entry] = field(default_factory=list)
+    committed_entries: List[Entry] = field(default_factory=list)
+    more_committed_entries: bool = False
+    snapshot: Optional[Snapshot] = None
+    ready_to_reads: List[ReadyToRead] = field(default_factory=list)
+    messages: List[Message] = field(default_factory=list)
+    last_applied: int = 0
+    update_commit: UpdateCommit = field(default_factory=UpdateCommit)
+    fast_apply: bool = True
+    dropped_entries: List[Entry] = field(default_factory=list)
+    dropped_read_indexes: List[SystemCtx] = field(default_factory=list)
+
+    def has_update(self) -> bool:
+        return bool(
+            not self.state.is_empty()
+            or self.entries_to_save
+            or self.committed_entries
+            or self.messages
+            or self.ready_to_reads
+            or (self.snapshot is not None and not self.snapshot.is_empty())
+        )
+
+
+@dataclass(slots=True)
+class Bootstrap:
+    """Bootstrap record persisted on first start (cf. raftpb Bootstrap)."""
+
+    addresses: dict = field(default_factory=dict)  # node_id -> address
+    join: bool = False
+    type: int = 0
+
+    def validate(self, nodes: dict, join: bool, smtype: int) -> bool:
+        # cf. raftpb/raft.go:221-258 Bootstrap.Validate
+        if not self.join and len(self.addresses) == 0:
+            return False
+        if not self.join and join:
+            return False
+        if self.join and len(nodes) > 0:
+            return False
+        if self.type != 0 and smtype != 0 and self.type != smtype:
+            return False
+        if not self.join and not join:
+            if len(nodes) != len(self.addresses):
+                return False
+            for nid, addr in nodes.items():
+                if self.addresses.get(nid) != addr:
+                    return False
+        return True
+
+
+def entries_size(entries: Sequence[Entry]) -> int:
+    """Approximate in-memory footprint used for flow control accounting."""
+    return sum(len(e.cmd) + 48 for e in entries)
+
+
+def limit_entry_size(entries: List[Entry], max_size: int) -> List[Entry]:
+    """Cap the slice at max_size bytes but always keep >=1 entry
+    (cf. internal/raft/entryutils.go limitSize)."""
+    if not entries:
+        return entries
+    total = 0
+    for i, e in enumerate(entries):
+        total += len(e.cmd) + 48
+        if total > max_size and i > 0:
+            return entries[:i]
+    return entries
+
+
+def assert_contiguous(entries: Sequence[Entry]) -> None:
+    """Panic on holes in an entry slice (cf. entryutils.go:36-48)."""
+    for i in range(1, len(entries)):
+        if entries[i].index != entries[i - 1].index + 1:
+            raise RuntimeError(
+                f"log hole found between {entries[i-1].index} and {entries[i].index}"
+            )
+
+
+__all__ = [
+    "NO_LEADER",
+    "NO_NODE",
+    "NO_LIMIT",
+    "MessageType",
+    "EntryType",
+    "ConfigChangeType",
+    "CompressionType",
+    "Entry",
+    "ConfigChange",
+    "Membership",
+    "Snapshot",
+    "SnapshotFile",
+    "SnapshotChunk",
+    "State",
+    "EMPTY_STATE",
+    "SystemCtx",
+    "ReadyToRead",
+    "Message",
+    "MessageBatch",
+    "Update",
+    "UpdateCommit",
+    "Bootstrap",
+    "NOOP_CLIENT_ID",
+    "NOOP_SERIES_ID",
+    "SERIES_ID_FOR_REGISTER",
+    "SERIES_ID_FOR_UNREGISTER",
+    "SERIES_ID_FIRST_PROPOSAL",
+    "is_local_message",
+    "is_response_message",
+    "is_request_message",
+    "is_leader_message",
+    "entries_size",
+    "limit_entry_size",
+    "assert_contiguous",
+    "replace",
+]

@@ -1,0 +1,131 @@
+"""In-memory multi-peer harness for driving scalar Raft protocol scenarios,
+modeled on the network-free approach of the reference's raft tests
+(cf. internal/raft/raft_test.go: tests drive multiple raft instances purely
+through the message interface with a stub ILogDB)."""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import InMemLogDB
+from dragonboat_tpu.core.raft import Raft, RaftNodeState
+from dragonboat_tpu.core.remote import Remote
+from dragonboat_tpu.types import (
+    Entry,
+    Message,
+    MessageType,
+    is_local_message,
+)
+
+MT = MessageType
+
+
+def make_config(node_id: int, election: int = 10, heartbeat: int = 1, **kw) -> Config:
+    return Config(
+        node_id=node_id,
+        cluster_id=1,
+        election_rtt=election,
+        heartbeat_rtt=heartbeat,
+        **kw,
+    )
+
+
+def new_test_raft(
+    node_id: int,
+    peers: List[int],
+    election: int = 10,
+    heartbeat: int = 1,
+    logdb: Optional[InMemLogDB] = None,
+    seed: int = 0,
+    **kw,
+) -> Raft:
+    logdb = logdb if logdb is not None else InMemLogDB()
+    r = Raft(
+        make_config(node_id, election, heartbeat, **kw),
+        logdb,
+        rng=random.Random(seed + node_id),
+    )
+    if not r.remotes:
+        for p in peers:
+            r.remotes[p] = Remote(next=1)
+    return r
+
+
+class Network:
+    """Routes messages between raft instances; supports drops/isolation."""
+
+    def __init__(self, rafts: Dict[int, Raft]):
+        self.rafts = rafts
+        self.dropped: set = set()  # (from, to) pairs
+        self.isolated: set = set()
+        self.drop_rate = 0.0
+        self.rng = random.Random(42)
+
+    def drop(self, frm: int, to: int) -> None:
+        self.dropped.add((frm, to))
+
+    def isolate(self, node_id: int) -> None:
+        self.isolated.add(node_id)
+
+    def heal(self) -> None:
+        self.dropped.clear()
+        self.isolated.clear()
+
+    def _deliverable(self, m: Message) -> bool:
+        if (m.from_, m.to) in self.dropped:
+            return False
+        if m.from_ in self.isolated or m.to in self.isolated:
+            return False
+        if self.drop_rate > 0 and self.rng.random() < self.drop_rate:
+            return False
+        return True
+
+    def collect(self) -> List[Message]:
+        msgs: List[Message] = []
+        for r in self.rafts.values():
+            msgs.extend(r.msgs)
+            r.msgs = []
+        return msgs
+
+    def deliver_all(self, max_rounds: int = 100) -> None:
+        """Deliver messages until quiescent."""
+        for _ in range(max_rounds):
+            msgs = self.collect()
+            pending = [m for m in msgs if not is_local_message(m.type)]
+            if not pending:
+                return
+            for m in pending:
+                if m.to in self.rafts and self._deliverable(m):
+                    self.rafts[m.to].handle(m)
+
+    def send(self, m: Message) -> None:
+        """Inject a message then run to quiescence (like etcd's nt.send)."""
+        self.rafts[m.to].handle(m)
+        self.deliver_all()
+
+    def elect(self, node_id: int) -> None:
+        self.send(Message(type=MT.ELECTION, to=node_id, from_=node_id))
+
+    def propose(self, node_id: int, cmd: bytes = b"x") -> None:
+        self.send(
+            Message(
+                type=MT.PROPOSE,
+                to=node_id,
+                from_=node_id,
+                entries=[Entry(cmd=cmd)],
+            )
+        )
+
+
+def make_cluster(n: int, election: int = 10, heartbeat: int = 1) -> Network:
+    ids = list(range(1, n + 1))
+    rafts = {}
+    for nid in ids:
+        r = new_test_raft(nid, ids, election, heartbeat)
+        rafts[nid] = r
+    return Network(rafts)
+
+
+def state_of(r: Raft) -> RaftNodeState:
+    return r.state

@@ -1,0 +1,233 @@
+"""Tests for the two-tier log (EntryLog/InMemory) and the Peer update
+contract, modeled on internal/raft/logentry_etcd_test.go and
+inmemory_etcd_test.go scenarios."""
+import pytest
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import (
+    EntryLog,
+    ErrCompacted,
+    InMemLogDB,
+    InMemory,
+)
+from dragonboat_tpu.core.peer import Peer, PeerAddress
+from dragonboat_tpu.types import Entry, Membership, Snapshot, State
+
+
+def ents(*pairs):
+    return [Entry(index=i, term=t) for i, t in pairs]
+
+
+# ------------------------------------------------------------------ InMemory
+
+
+def test_inmemory_merge_append():
+    im = InMemory(0)
+    im.merge(ents((1, 1), (2, 1)))
+    assert [e.index for e in im.entries] == [1, 2]
+    im.merge(ents((3, 1)))
+    assert [e.index for e in im.entries] == [1, 2, 3]
+
+
+def test_inmemory_merge_replace_all():
+    im = InMemory(0)
+    im.merge(ents((1, 1), (2, 1)))
+    im.saved_to = 2
+    im.merge(ents((1, 2)))
+    assert [e.term for e in im.entries] == [2]
+    assert im.marker_index == 1
+    assert im.saved_to == 0  # rewound: new entries must be saved again
+
+
+def test_inmemory_merge_truncate_tail():
+    im = InMemory(0)
+    im.merge(ents((1, 1), (2, 1), (3, 1)))
+    im.saved_to = 3
+    im.merge(ents((3, 2), (4, 2)))
+    assert [(e.index, e.term) for e in im.entries] == [(1, 1), (2, 1), (3, 2), (4, 2)]
+    assert im.saved_to == 2
+
+
+def test_inmemory_entries_to_save_watermark():
+    im = InMemory(0)
+    im.merge(ents((1, 1), (2, 1)))
+    assert [e.index for e in im.entries_to_save()] == [1, 2]
+    im.saved_log_to(2, 1)
+    assert im.entries_to_save() == []
+    # wrong term: watermark does not advance
+    im.merge(ents((3, 2)))
+    im.saved_log_to(3, 9)
+    assert [e.index for e in im.entries_to_save()] == [3]
+
+
+def test_inmemory_applied_log_to_shrinks():
+    im = InMemory(0)
+    im.merge(ents((1, 1), (2, 1), (3, 1)))
+    im.applied_log_to(2)
+    assert im.marker_index == 2
+    assert [e.index for e in im.entries] == [2, 3]
+
+
+def test_inmemory_restore_snapshot():
+    im = InMemory(0)
+    im.merge(ents((1, 1)))
+    ss = Snapshot(index=10, term=3, membership=Membership())
+    im.restore(ss)
+    assert im.marker_index == 11
+    assert im.entries == []
+    assert im.get_term(10) == 3
+
+
+# ------------------------------------------------------------------ EntryLog
+
+
+def make_log(db_entries=(), marker=(0, 0)):
+    db = InMemLogDB()
+    if marker != (0, 0):
+        db.apply_snapshot(Snapshot(index=marker[0], term=marker[1]))
+    if db_entries:
+        db.append(list(db_entries))
+    return EntryLog(db), db
+
+
+def test_entrylog_term_merges_tiers():
+    log, db = make_log(ents((1, 1), (2, 2)))
+    assert log.term(1) == 1
+    assert log.term(2) == 2
+    log.append(ents((3, 3)))
+    assert log.term(3) == 3
+    assert log.last_index() == 3
+    assert log.last_term() == 3
+
+
+def test_entrylog_up_to_date():
+    log, _ = make_log(ents((1, 1), (2, 2)))
+    assert log.up_to_date(2, 3)  # higher term wins
+    assert log.up_to_date(2, 2)  # same term, same index
+    assert log.up_to_date(5, 2)  # same term, longer log
+    assert not log.up_to_date(1, 2)  # same term, shorter log
+    assert not log.up_to_date(5, 1)  # lower term loses regardless of length
+
+
+def test_entrylog_try_append_conflict():
+    log, _ = make_log()
+    log.append(ents((1, 1), (2, 1), (3, 1)))
+    log.commit_to(1)
+    # conflicting suffix from index 2 at term 2
+    assert log.try_append(1, ents((2, 2), (3, 2)))
+    assert log.term(2) == 2
+    assert log.term(3) == 2
+    # matching entries: no-op
+    assert not log.try_append(1, ents((2, 2)))
+
+
+def test_entrylog_try_append_conflict_below_committed_panics():
+    log, _ = make_log()
+    log.append(ents((1, 1), (2, 1)))
+    log.commit_to(2)
+    with pytest.raises(RuntimeError):
+        log.try_append(0, ents((1, 2), (2, 2)))
+
+
+def test_entrylog_try_commit_current_term_only():
+    log, _ = make_log()
+    log.append(ents((1, 1), (2, 2)))
+    # quorum at index 1 but term 2 is current: old-term entry not committed
+    assert not log.try_commit(1, 2)
+    assert log.try_commit(2, 2)
+    assert log.committed == 2
+
+
+def test_entrylog_compaction_error():
+    log, db = make_log(ents((5, 1), (6, 1)), marker=(4, 1))
+    assert log.first_index() == 5
+    with pytest.raises(ErrCompacted):
+        log.get_entries(3, 7, 1 << 30)
+    assert log.term(4) == 1  # marker term accessible
+
+
+def test_entrylog_commit_beyond_last_panics():
+    log, _ = make_log(ents((1, 1)))
+    with pytest.raises(RuntimeError):
+        log.commit_to(5)
+
+
+# ------------------------------------------------------------------ Peer
+
+
+def launch_single():
+    db = InMemLogDB()
+    cfg = Config(node_id=1, cluster_id=7, election_rtt=10, heartbeat_rtt=2)
+    return (
+        Peer.launch(
+            cfg,
+            db,
+            addresses=[PeerAddress(node_id=1, address="a1")],
+            initial=True,
+            new_node=True,
+        ),
+        db,
+    )
+
+
+def test_peer_bootstrap_writes_config_change_entries():
+    p, _ = launch_single()
+    r = p.raft
+    assert r.log.committed == 1  # one bootstrap entry per member
+    assert 1 in r.remotes
+    ud = p.get_update(True, 0)
+    assert len(ud.entries_to_save) == 1
+    assert ud.committed_entries  # bootstrap entry ready to apply
+    assert ud.state.term == 1
+
+
+def drain(p: Peer):
+    """Run one get_update/apply/commit round like the engine does."""
+    ud = p.get_update(True, p.raft.applied)
+    if ud.committed_entries:
+        p.notify_raft_last_applied(ud.committed_entries[-1].index)
+        ud.last_applied = ud.committed_entries[-1].index
+        ud.update_commit.last_applied = ud.last_applied
+    p.commit(ud)
+    return ud
+
+
+def test_peer_update_commit_cycle():
+    p, _ = launch_single()
+    drain(p)  # applies the bootstrap config-change entry
+    # elect self
+    for _ in range(30):
+        p.tick()
+    assert p.raft.is_leader()
+    p.propose_entries([Entry(cmd=b"job")])
+    ud = p.get_update(True, 0)
+    assert ud.entries_to_save
+    assert ud.update_commit.stable_log_to == ud.entries_to_save[-1].index
+    p.commit(ud)
+    # after commit, nothing new to save
+    ud2 = p.get_update(True, ud.update_commit.processed)
+    assert ud2.entries_to_save == []
+
+
+def test_peer_fast_apply_disabled_when_overlap():
+    p, _ = launch_single()
+    drain(p)
+    for _ in range(30):
+        p.tick()
+    p.propose_entries([Entry(cmd=b"x")])
+    ud = p.get_update(True, 0)
+    # committed entries overlap entries_to_save (single node commits its own
+    # entries instantly) => fast apply unsafe
+    if ud.committed_entries and ud.entries_to_save:
+        assert not ud.fast_apply
+
+
+def test_peer_has_update():
+    p, _ = launch_single()
+    ud = p.get_update(True, 0)
+    p.commit(ud)
+    assert not p.has_update(True)
+    p.tick()
+    p.propose_entries([Entry(cmd=b"y")])  # dropped or appended
+    # single node: if not yet leader the proposal is dropped => still update
+    assert p.has_update(True) or p.raft.is_leader()
