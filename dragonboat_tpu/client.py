@@ -36,7 +36,7 @@ class Session:
         return Session(
             cluster_id=cluster_id,
             client_id=cid,
-            series_id=SERIES_ID_FIRST_PROPOSAL - 1,
+            series_id=SERIES_ID_FIRST_PROPOSAL,
         )
 
     @staticmethod

@@ -1,0 +1,36 @@
+"""Vectorized multi-group Raft protocol kernels (JAX).
+
+The reference advances each Raft group with a per-group Step loop scheduled
+over 16 worker goroutines (cf. execengine.go:143-183, partitioned by
+clusterID % workers). Here the entire fleet of groups is a struct-of-arrays
+over a (groups, peers) layout and one jitted kernel advances all of them per
+step: the handler table (cf. internal/raft/raft.go:2037-2098) compiles to a
+fixed sequence of masked lane updates, quorum commit to an order-statistic
+reduction over the match tensor (cf. raft.go:859-907).
+"""
+from .state import (
+    KernelConfig,
+    RaftTensors,
+    Inbox,
+    StepOutput,
+    MSG,
+    ROLE,
+    RSTATE,
+    init_state,
+    make_empty_inbox,
+)
+from .kernel import step_batch, make_step_fn
+
+__all__ = [
+    "KernelConfig",
+    "RaftTensors",
+    "Inbox",
+    "StepOutput",
+    "MSG",
+    "ROLE",
+    "RSTATE",
+    "init_state",
+    "make_empty_inbox",
+    "step_batch",
+    "make_step_fn",
+]

@@ -1,0 +1,967 @@
+"""step_batch: advance all Raft groups one protocol step in one compiled call.
+
+The reference advances each group with a per-group handler table dispatch
+(internal/raft/raft.go:2030-2098) inside 16 worker goroutines. Here the whole
+fleet advances at once:
+
+  1. tick phase      — election/heartbeat/check-quorum timers as tensor ops
+                       (cf. raft.go:523-634)
+  2. inbox scan      — lax.scan over K message slots; each iteration applies
+                       one message per group, the handler table realized as
+                       masked lane updates
+  3. replication fan-out — for every (group, peer) with next <= last_index
+                       and an unpaused flow-control lane, emit a Replicate
+                       send descriptor (unifies the reference's
+                       broadcastReplicateMessage + lagging-peer catch-up,
+                       cf. raft.go:794-815, 1679-1684)
+  4. quorum commit   — k-th order statistic over match[G,P] with the
+                       current-term restriction (cf. raft.go:859-907)
+  5. output assembly — save/apply ranges and send descriptors for the engine
+
+Control flow never branches per group: every handler computes its candidate
+update for every lane and reality is selected by masks. This trades FLOPs
+(cheap, elementwise) for the absence of divergence — the shape XLA wants.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .state import (
+    MSG,
+    NEED_SNAPSHOT,
+    ROLE,
+    RSTATE,
+    SEND_HEARTBEAT,
+    SEND_REPLICATE,
+    SEND_TIMEOUT_NOW,
+    SEND_VOTE_REQ,
+    Inbox,
+    KernelConfig,
+    RaftTensors,
+    StepOutput,
+)
+
+i32 = jnp.int32
+
+
+def _mix(a, b, c):
+    """Deterministic integer mix for randomized election timeouts. Seeded by
+    (group seed, term, slot) so replicas of one group never tie forever —
+    replaces the reference's global locked RNG (raft.go:631-634)."""
+    u = jnp.uint32
+    x = (a * u(2654435761)) ^ (b.astype(u) * u(40503)) ^ (c.astype(u) * u(2246822519))
+    x = x ^ (x >> 15)
+    x = x * u(2246822519)
+    x = x ^ (x >> 13)
+    return x
+
+
+def _rand_timeout(seed, term, slot, et):
+    return et + (_mix(seed, term, slot) % et.astype(jnp.uint32)).astype(i32)
+
+
+def _term_at(s: RaftTensors, idx):
+    """Term of entry idx (i32[G]): ring lookup, marker, or 0 out-of-window
+    (cf. logentry.go term())."""
+    W = s.log_term.shape[1]
+    in_ring = (idx >= s.first_index) & (idx <= s.last_index) & (idx >= 1)
+    ring = jnp.take_along_axis(s.log_term, (idx % W)[:, None], axis=1)[:, 0]
+    marker = idx == (s.first_index - 1)
+    return jnp.where(in_ring, ring, jnp.where(marker, s.marker_term, 0))
+
+
+def _self_mask(s: RaftTensors):
+    """bool[G,P]: True at each group's own slot."""
+    P = s.member.shape[1]
+    return jax.nn.one_hot(s.self_slot, P, dtype=bool)
+
+
+def _num_voting(s: RaftTensors):
+    return jnp.sum(s.voting, axis=1).astype(i32)
+
+
+def _quorum(s: RaftTensors):
+    return _num_voting(s) // 2 + 1
+
+
+def _reset(s: RaftTensors, new_term, keep_term_vote=False) -> RaftTensors:
+    """The shared reset on any role change (cf. raft.go reset()):
+    vote cleared on term change, timers rewound, randomized timeout
+    refreshed, votes/readindex/transfer/pending-cc cleared, remotes reset to
+    next = last+1 (match = last for self)."""
+    term_changed = new_term != s.term
+    vote = jnp.where(term_changed, 0, s.vote)
+    selfm = _self_mask(s)
+    last = s.last_index
+    return s._replace(
+        term=new_term,
+        vote=vote,
+        election_tick=jnp.zeros_like(s.election_tick),
+        heartbeat_tick=jnp.zeros_like(s.heartbeat_tick),
+        rand_timeout=_rand_timeout(
+            s.seed, new_term, s.self_slot, s.election_timeout
+        ),
+        vresp=jnp.zeros_like(s.vresp),
+        vgrant=jnp.zeros_like(s.vgrant),
+        transfer_to=jnp.zeros_like(s.transfer_to),
+        pending_cc=jnp.zeros_like(s.pending_cc),
+        ri_ctx=jnp.zeros_like(s.ri_ctx),
+        ri_index=jnp.zeros_like(s.ri_index),
+        ri_acks=jnp.zeros_like(s.ri_acks),
+        ri_count=jnp.zeros_like(s.ri_count),
+        match=jnp.where(selfm, last[:, None], 0),
+        next=jnp.broadcast_to((last + 1)[:, None], s.next.shape),
+        rstate=jnp.zeros_like(s.rstate),
+        snap_sent=jnp.zeros_like(s.snap_sent),
+    )
+
+
+def _merge(mask, new: RaftTensors, old: RaftTensors) -> RaftTensors:
+    """Select new state for lanes where mask[G] is True."""
+    def sel(n, o):
+        if n is o:
+            return o
+        m = mask
+        while m.ndim < n.ndim:
+            m = m[..., None]
+        return jnp.where(m, n, o)
+
+    return jax.tree.map(sel, new, old)
+
+
+def _become_follower(s: RaftTensors, mask, new_term, leader) -> RaftTensors:
+    """Follower/observer/witness demotion preserving the special roles
+    (cf. raft.go becomeFollower/becomeObserver/becomeWitness)."""
+    ns = _reset(s, jnp.where(mask, new_term, s.term))
+    new_role = jnp.where(
+        (s.role == ROLE.OBSERVER) | (s.role == ROLE.WITNESS), s.role, ROLE.FOLLOWER
+    )
+    ns = ns._replace(role=new_role, leader=leader)
+    return _merge(mask, ns, s)
+
+
+def _append_one(s: RaftTensors, mask, is_cc) -> RaftTensors:
+    """Append one entry at the current term on masked lanes (leader path)."""
+    W = s.log_term.shape[1]
+    idx = s.last_index + 1
+    slot = idx % W
+    onehot = jax.nn.one_hot(slot, W, dtype=bool) & mask[:, None]
+    log_term = jnp.where(onehot, s.term[:, None], s.log_term)
+    log_cc = jnp.where(onehot, is_cc[:, None], s.log_is_cc)
+    last = jnp.where(mask, idx, s.last_index)
+    selfm = _self_mask(s)
+    match = jnp.where(selfm & mask[:, None], last[:, None], s.match)
+    return s._replace(
+        log_term=log_term, log_is_cc=log_cc, last_index=last, match=match
+    )
+
+
+def _become_leader(s: RaftTensors, mask) -> RaftTensors:
+    """Candidate -> leader on masked lanes: reset remotes, append the
+    new-term noop entry (cf. raft.go:975-987). The caller records the noop
+    index for the host."""
+    ns = _reset(s, s.term)
+    ns = ns._replace(
+        role=jnp.where(mask, ROLE.LEADER, ns.role),
+        leader=jnp.where(mask, s.self_slot + 1, ns.leader),
+        # pending config change is re-armed if an uncommitted cc exists in
+        # the log window (cf. preLeaderPromotionHandleConfigChange); computed
+        # by scanning the uncommitted window's cc bits.
+        pending_cc=jnp.where(mask, _has_uncommitted_cc(s), ns.pending_cc),
+    )
+    ns = _append_one(ns, mask, jnp.zeros_like(mask))
+    return _merge(mask, ns, s)
+
+
+def _has_uncommitted_cc(s: RaftTensors):
+    """bool[G]: any config-change entry in (committed, last_index]."""
+    W = s.log_is_cc.shape[1]
+    idxs = jnp.arange(W, dtype=i32)[None, :]
+    # reconstruct each ring slot's absolute index: the slot holds the largest
+    # index <= last with index % W == slot and index >= first
+    # simpler: an entry at absolute index i is live iff first<=i<=last; slot
+    # i%W. For the uncommitted window check we scan all live slots.
+    base = (s.last_index[:, None] // W) * W
+    cand = base + idxs
+    cand = jnp.where(cand > s.last_index[:, None], cand - W, cand)
+    live = (cand > s.committed[:, None]) & (cand >= s.first_index[:, None]) & (
+        cand <= s.last_index[:, None]
+    )
+    return jnp.any(live & s.log_is_cc, axis=1)
+
+
+def _campaign(s: RaftTensors, mask, out, transfer_hint) -> Tuple[RaftTensors, dict]:
+    """Start an election on masked lanes (cf. raft.go campaign()):
+    become candidate (term+1, vote self), emit RequestVote descriptors;
+    single-node quorum becomes leader instantly."""
+    can = (
+        mask
+        & s.active
+        & (s.role != ROLE.LEADER)
+        & (s.role != ROLE.OBSERVER)
+        & (s.role != ROLE.WITNESS)
+        # campaign blocked while config changes are committed-but-unapplied
+        # (cf. raft.go:1484-1508)
+        & ~_has_cc_to_apply(s)
+        # self still a member
+        & jnp.any(s.voting & _self_mask(s), axis=1)
+    )
+    ns = _reset(s, s.term + 1)
+    ns = ns._replace(
+        role=jnp.where(can, ROLE.CANDIDATE, ns.role),
+        leader=jnp.where(can, 0, ns.leader),
+        vote=jnp.where(can, s.self_slot + 1, ns.vote),
+        vresp=jnp.where(can[:, None], _self_mask(s), ns.vresp),
+        vgrant=jnp.where(can[:, None], _self_mask(s), ns.vgrant),
+    )
+    ns = _merge(can, ns, s)
+    # single voting member: leader immediately
+    single = can & (_num_voting(ns) == 1)
+    noop_at = jnp.where(single, ns.last_index + 1, 0)
+    ns = _become_leader(ns, single)
+    # vote requests to all other voting members
+    others = ns.voting & ~_self_mask(ns)
+    flags = jnp.where(
+        (can & ~single)[:, None] & others,
+        out["send_flags"] | SEND_VOTE_REQ,
+        out["send_flags"],
+    )
+    hint = jnp.where(
+        (can & ~single & transfer_hint)[:, None] & others,
+        ns.self_slot[:, None] + 1,
+        out["send_hint"],
+    )
+    out = dict(out, send_flags=flags, send_hint=hint)
+    out["noop_appended"] = jnp.maximum(out["noop_appended"], noop_at)
+    return ns, out
+
+
+def _has_cc_to_apply(s: RaftTensors):
+    """bool[G]: config-change entry in (applied, committed]."""
+    W = s.log_is_cc.shape[1]
+    idxs = jnp.arange(W, dtype=i32)[None, :]
+    base = (s.last_index[:, None] // W) * W
+    cand = base + idxs
+    cand = jnp.where(cand > s.last_index[:, None], cand - W, cand)
+    live = (
+        (cand > s.applied[:, None])
+        & (cand <= s.committed[:, None])
+        & (cand >= s.first_index[:, None])
+    )
+    return jnp.any(live & s.log_is_cc, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# message handling (one inbox slot across all groups)
+# ---------------------------------------------------------------------------
+
+
+def _is_leader_msg(t):
+    return (
+        (t == MSG.REPLICATE)
+        | (t == MSG.INSTALL_SNAPSHOT)
+        | (t == MSG.HEARTBEAT)
+        | (t == MSG.TIMEOUT_NOW)
+        | (t == MSG.READ_INDEX_RESP)
+    )
+
+
+def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
+    """Apply one message per group (the k-th inbox slot). Implements the
+    term-matching preamble (raft.go:1415-1449) then the handler table as
+    masked updates."""
+    P = s.member.shape[1]
+    W = s.log_term.shape[1]
+    E = cfg.max_entries_per_msg
+    mtype = m["mtype"]
+    present = mtype != MSG.NONE
+    from_slot = m["from_slot"]
+    mterm = m["term"]
+
+    # ---- term preamble -----------------------------------------------------
+    local = mterm == 0
+    higher = present & ~local & (mterm > s.term)
+    lower = present & ~local & (mterm < s.term)
+    # disruption defense (raft.go:1387-1409)
+    drop_rv = (
+        higher
+        & (mtype == MSG.REQUEST_VOTE)
+        & s.check_quorum
+        & (m["hint"] != from_slot + 1)
+        & (s.leader != 0)
+        & (s.election_tick < s.election_timeout)
+    )
+    step_down = higher & ~drop_rv
+    new_leader = jnp.where(_is_leader_msg(mtype), from_slot + 1, 0)
+    s = _become_follower(s, step_down, mterm, jnp.where(step_down, new_leader, s.leader))
+    # lower-term leader msg + check-quorum => NOOP response to free a stuck
+    # candidate (raft.go:1441-1447); everything lower-term is then dropped
+    noop_resp = lower & _is_leader_msg(mtype) & s.check_quorum
+    dropped = lower | drop_rv
+    act = present & ~dropped
+
+    is_leader = s.role == ROLE.LEADER
+    is_cand = s.role == ROLE.CANDIDATE
+    is_obs = s.role == ROLE.OBSERVER
+    is_wit = s.role == ROLE.WITNESS
+    is_fol = s.role == ROLE.FOLLOWER
+
+    resp_type = jnp.where(noop_resp, MSG.NOOP, MSG.NONE)
+    resp_to = from_slot
+    resp_log_index = jnp.zeros_like(mterm)
+    resp_reject = jnp.zeros_like(act)
+    resp_hint = jnp.zeros_like(mterm)
+    resp_hint2 = jnp.zeros_like(mterm)
+
+    selfm = _self_mask(s)
+    from_onehot = jax.nn.one_hot(from_slot, P, dtype=bool)
+    known_from = jnp.any(s.member & from_onehot, axis=1)
+
+    # ---- RequestVote (any state) ------------------------------------------
+    rv = act & (mtype == MSG.REQUEST_VOTE) & (is_fol | is_cand | is_leader | is_wit)
+    can_grant = (s.vote == 0) | (s.vote == from_slot + 1)
+    last_term = _term_at(s, s.last_index)
+    utd = (m["log_term"] > last_term) | (
+        (m["log_term"] == last_term) & (m["log_index"] >= s.last_index)
+    )
+    grant = rv & can_grant & utd
+    s = s._replace(
+        vote=jnp.where(grant, from_slot + 1, s.vote),
+        election_tick=jnp.where(grant, 0, s.election_tick),
+    )
+    resp_type = jnp.where(rv, MSG.REQUEST_VOTE_RESP, resp_type)
+    resp_reject = jnp.where(rv, ~grant, resp_reject)
+
+    # ---- RequestVoteResp (candidate) --------------------------------------
+    rvr = act & (mtype == MSG.REQUEST_VOTE_RESP) & is_cand & known_from
+    first_resp = rvr & ~jnp.any(s.vresp & from_onehot, axis=1)
+    s = s._replace(
+        vresp=jnp.where(first_resp[:, None] & from_onehot, True, s.vresp),
+        vgrant=jnp.where(
+            first_resp[:, None] & from_onehot, ~m["reject"][:, None], s.vgrant
+        ),
+    )
+    granted = jnp.sum(s.vgrant & s.voting, axis=1).astype(i32)
+    rejected = jnp.sum(s.vresp & ~s.vgrant & s.voting, axis=1).astype(i32)
+    q = _quorum(s)
+    win = rvr & (granted >= q)
+    lose = rvr & ~win & (rejected >= q)
+    noop_at = jnp.where(win, s.last_index + 1, 0)
+    s = _become_leader(s, win)
+    out["noop_appended"] = jnp.maximum(out["noop_appended"], noop_at)
+    s = _become_follower(s, lose, s.term, jnp.zeros_like(s.leader))
+
+    # ---- Election / TimeoutNow --------------------------------------------
+    ele = act & (mtype == MSG.ELECTION)
+    tno = act & (mtype == MSG.TIMEOUT_NOW) & is_fol
+    s, out = _campaign(s, ele | tno, out, transfer_hint=tno)
+
+    # ---- Replicate (non-leader) -------------------------------------------
+    rep = act & (mtype == MSG.REPLICATE) & (is_fol | is_obs | is_wit | is_cand)
+    # candidate at same term: a leader exists -> become follower (raft.go:1944)
+    s = _become_follower(
+        s, rep & is_cand, s.term, jnp.where(rep & is_cand, from_slot + 1, s.leader)
+    )
+    s = s._replace(
+        leader=jnp.where(rep, from_slot + 1, s.leader),
+        election_tick=jnp.where(rep, 0, s.election_tick),
+    )
+    prev = m["log_index"]
+    nent = m["n_entries"]
+    stale = rep & (prev < s.committed)
+    match_prev = _term_at(s, prev) == m["log_term"]
+    in_window = (prev >= s.first_index - 1) & (prev <= s.last_index)
+    ok = rep & ~stale & match_prev & in_window
+    rej = rep & ~stale & ~ok
+    # conflict scan over the E attached entries
+    if E > 0:
+        e_idx = prev[:, None] + 1 + jnp.arange(E, dtype=i32)[None, :]
+        e_valid = jnp.arange(E, dtype=i32)[None, :] < nent[:, None]
+        have = e_idx <= s.last_index[:, None]
+        exist_term = jnp.take_along_axis(s.log_term, e_idx % W, axis=1)
+        conflict = e_valid & (~have | (exist_term != m["entry_terms"]))
+        first_conf = jnp.min(
+            jnp.where(conflict, e_idx, jnp.iinfo(jnp.int32).max), axis=1
+        )
+        any_conf = jnp.any(conflict, axis=1)
+        do_append = ok & any_conf
+        # write entries from the first conflicting index on
+        wmask = do_append[:, None] & e_valid & (e_idx >= first_conf[:, None])
+        slot = e_idx % W
+        # scatter via one-hot matmul-free approach: loop over E (static, small)
+        log_term = s.log_term
+        log_cc = s.log_is_cc
+        for e in range(E):
+            oh = jax.nn.one_hot(slot[:, e], W, dtype=bool) & wmask[:, e : e + 1]
+            log_term = jnp.where(oh, m["entry_terms"][:, e : e + 1], log_term)
+            log_cc = jnp.where(oh, m["entry_cc"][:, e : e + 1], log_cc)
+        new_last = jnp.where(do_append, prev + nent, s.last_index)
+        s = s._replace(
+            log_term=log_term,
+            log_is_cc=log_cc,
+            last_index=new_last,
+            unsaved_from=jnp.where(
+                do_append, jnp.minimum(s.unsaved_from, first_conf), s.unsaved_from
+            ),
+        )
+    ack_to = prev + nent
+    new_commit = jnp.clip(jnp.minimum(ack_to, m["commit"]), s.committed, s.last_index)
+    s = s._replace(committed=jnp.where(ok, new_commit, s.committed))
+    resp_type = jnp.where(rep, MSG.REPLICATE_RESP, resp_type)
+    resp_log_index = jnp.where(
+        stale, s.committed, jnp.where(ok, ack_to, jnp.where(rej, prev, resp_log_index))
+    )
+    resp_reject = jnp.where(rej, True, resp_reject)
+    resp_hint = jnp.where(rej, s.last_index, resp_hint)
+
+    # ---- Heartbeat (non-leader) -------------------------------------------
+    hb = act & (mtype == MSG.HEARTBEAT) & (is_fol | is_obs | is_wit | is_cand)
+    s = _become_follower(
+        s, hb & is_cand, s.term, jnp.where(hb & is_cand, from_slot + 1, s.leader)
+    )
+    s = s._replace(
+        leader=jnp.where(hb, from_slot + 1, s.leader),
+        election_tick=jnp.where(hb, 0, s.election_tick),
+        committed=jnp.where(
+            hb, jnp.clip(m["commit"], s.committed, s.last_index), s.committed
+        ),
+    )
+    resp_type = jnp.where(hb, MSG.HEARTBEAT_RESP, resp_type)
+    resp_hint = jnp.where(hb, m["hint"], resp_hint)
+    resp_hint2 = jnp.where(hb, m["hint_high"], resp_hint2)
+
+    # ---- ReplicateResp (leader) -------------------------------------------
+    rr = act & (mtype == MSG.REPLICATE_RESP) & (s.role == ROLE.LEADER) & known_from
+    fr = from_onehot  # [G,P]
+    prev_rstate = s.rstate
+    racc = rr & ~m["reject"]
+    moved = racc & (m["log_index"] > jnp.sum(jnp.where(fr, s.match, 0), axis=1))
+    s = s._replace(
+        ract=jnp.where(rr[:, None] & fr, True, s.ract),
+        match=jnp.where(
+            racc[:, None] & fr, jnp.maximum(s.match, m["log_index"][:, None]), s.match
+        ),
+        next=jnp.where(
+            racc[:, None] & fr,
+            jnp.maximum(s.next, m["log_index"][:, None] + 1),
+            s.next,
+        ),
+    )
+    # respondedTo(): RETRY -> REPLICATE; SNAPSHOT -> RETRY once caught up
+    # (remote.go:145-153); WAIT -> RETRY on movement (tryUpdate)
+    st = s.rstate
+    st = jnp.where(
+        moved[:, None] & fr & (st == RSTATE.WAIT), RSTATE.RETRY, st
+    )
+    st = jnp.where(moved[:, None] & fr & (st == RSTATE.RETRY), RSTATE.REPLICATE, st)
+    caught = s.match >= s.snap_sent
+    st = jnp.where(
+        moved[:, None] & fr & (st == RSTATE.SNAPSHOT) & caught, RSTATE.RETRY, st
+    )
+    s = s._replace(rstate=st)
+    # rejection: flow-control backoff (remote.go:155-171)
+    rrej = rr & m["reject"]
+    in_repl = jnp.any(fr & (prev_rstate == RSTATE.REPLICATE), axis=1)
+    cur_match = jnp.sum(jnp.where(fr, s.match, 0), axis=1)
+    cur_next = jnp.sum(jnp.where(fr, s.next, 0), axis=1)
+    valid_repl = rrej & in_repl & (m["log_index"] > cur_match)
+    valid_probe = rrej & ~in_repl & (cur_next - 1 == m["log_index"])
+    nn = jnp.where(
+        valid_repl,
+        cur_match + 1,
+        jnp.maximum(1, jnp.minimum(m["log_index"], m["hint"] + 1)),
+    )
+    dec = valid_repl | valid_probe
+    s = s._replace(
+        next=jnp.where(dec[:, None] & fr, nn[:, None], s.next),
+        rstate=jnp.where(
+            dec[:, None] & fr, RSTATE.RETRY, s.rstate
+        ),
+    )
+    # transfer fast path: target caught up => TimeoutNow (raft.go:1679-1684)
+    tt = s.transfer_to
+    t_caught = (
+        racc
+        & (tt != 0)
+        & (from_slot + 1 == tt)
+        & (jnp.sum(jnp.where(fr, s.match, 0), axis=1) == s.last_index)
+    )
+    out["send_flags"] = jnp.where(
+        t_caught[:, None] & fr, out["send_flags"] | SEND_TIMEOUT_NOW, out["send_flags"]
+    )
+
+    # ---- HeartbeatResp (leader) -------------------------------------------
+    hr = act & (mtype == MSG.HEARTBEAT_RESP) & (s.role == ROLE.LEADER) & known_from
+    s = s._replace(
+        ract=jnp.where(hr[:, None] & fr, True, s.ract),
+        rstate=jnp.where(
+            hr[:, None] & fr & (s.rstate == RSTATE.WAIT), RSTATE.RETRY, s.rstate
+        ),
+    )
+    # a peer whose match lags gets a (possibly empty) Replicate probe; the
+    # reject/backoff cycle then recovers lost optimistic sends
+    # (cf. raft.go:1794-1800 handleLeaderHeartbeatResp)
+    out["force_probe"] = out["force_probe"] | (
+        hr[:, None] & fr & (s.match < s.last_index[:, None])
+    )
+    # readindex leadership confirmation (raft.go:1736-1756)
+    R = s.ri_ctx.shape[1]
+    hint_match = hr[:, None] & (s.ri_ctx == m["hint"][:, None]) & (s.ri_ctx != 0)
+    frombit = (jnp.int32(1) << from_slot)[:, None]
+    s = s._replace(ri_acks=jnp.where(hint_match, s.ri_acks | frombit, s.ri_acks))
+
+    # ---- ReadIndex (leader) ------------------------------------------------
+    ri = act & (mtype == MSG.READ_INDEX) & (s.role == ROLE.LEADER)
+    qq = _quorum(s)
+    single = _num_voting(s) == 1
+    committed_this_term = _term_at(s, s.committed) == s.term
+    ok_ri = ri & (single | committed_this_term)
+    slot_free = s.ri_count < R
+    enq = ok_ri & ~single & slot_free
+    pos = s.ri_count
+    posm = jax.nn.one_hot(pos, R, dtype=bool) & enq[:, None]
+    s = s._replace(
+        ri_ctx=jnp.where(posm, m["hint"][:, None], s.ri_ctx),
+        ri_index=jnp.where(posm, s.committed[:, None], s.ri_index),
+        ri_acks=jnp.where(posm, 0, s.ri_acks),
+        ri_count=jnp.where(enq, s.ri_count + 1, s.ri_count),
+    )
+    # heartbeat broadcast with ctx hint
+    others_v = s.voting & ~selfm
+    out["send_flags"] = jnp.where(
+        enq[:, None] & others_v, out["send_flags"] | SEND_HEARTBEAT, out["send_flags"]
+    )
+    out["send_hint"] = jnp.where(
+        enq[:, None] & others_v, m["hint"][:, None], out["send_hint"]
+    )
+    # single-node: instantly ready (delivered via the ready queue at step end)
+    imm = ok_ri & single
+    posm2 = jax.nn.one_hot(s.ri_count, R, dtype=bool) & imm[:, None]
+    s = s._replace(
+        ri_ctx=jnp.where(posm2, m["hint"][:, None], s.ri_ctx),
+        ri_index=jnp.where(posm2, s.committed[:, None], s.ri_index),
+        ri_acks=jnp.where(posm2, jnp.int32(-1), s.ri_acks),
+        ri_count=jnp.where(imm, s.ri_count + 1, s.ri_count),
+    )
+    out["dropped_readindex"] = out["dropped_readindex"] + jnp.where(
+        (ri & ~ok_ri) | (ok_ri & ~single & ~slot_free), 1, 0
+    )
+
+    # ---- Propose (leader) --------------------------------------------------
+    # Host routes proposals to the group's leader replica; a lane that is not
+    # leader reports the forward target instead (host-side forwarding
+    # replaces the reference's follower Propose relay, raft.go:1839-1851).
+    pp = act & (mtype == MSG.PROPOSE)
+    pok = pp & (s.role == ROLE.LEADER) & (s.transfer_to == 0)
+    # config-change entries: at most one pending (raft.go:1587-1606).
+    # HOST INVARIANT: the engine packs a config-change entry alone in its own
+    # single-entry PROPOSE message (never mixed with regular entries), so the
+    # pending check is all-or-nothing per message.
+    e_in_msg = jnp.arange(E, dtype=i32)[None, :] < nent[:, None]
+    has_cc = jnp.any(m["entry_cc"] & e_in_msg, axis=1)
+    cc_allowed = pok & has_cc & ~s.pending_cc
+    cc_stripped = pok & has_cc & s.pending_cc
+    s = s._replace(pending_cc=jnp.where(cc_allowed, True, s.pending_cc))
+    out["dropped_cc"] = out["dropped_cc"] | cc_stripped
+    room = s.last_index - s.first_index + 1 + nent <= W
+    can_append = pok & room
+    # append up to E entries at the current term
+    if E > 0:
+        a_idx = s.last_index[:, None] + 1 + jnp.arange(E, dtype=i32)[None, :]
+        a_valid = (jnp.arange(E, dtype=i32)[None, :] < nent[:, None]) & can_append[
+            :, None
+        ]
+        slot = a_idx % W
+        log_term = s.log_term
+        log_cc = s.log_is_cc
+        eff_cc = m["entry_cc"] & cc_allowed[:, None]
+        for e in range(E):
+            oh = jax.nn.one_hot(slot[:, e], W, dtype=bool) & a_valid[:, e : e + 1]
+            log_term = jnp.where(oh, s.term[:, None], log_term)
+            log_cc = jnp.where(oh, eff_cc[:, e : e + 1], log_cc)
+        new_last = jnp.where(can_append, s.last_index + nent, s.last_index)
+        s = s._replace(
+            log_term=log_term,
+            log_is_cc=log_cc,
+            last_index=new_last,
+            match=jnp.where(selfm & can_append[:, None], new_last[:, None], s.match),
+        )
+    out["dropped_propose"] = out["dropped_propose"] + jnp.where(
+        pp & ~can_append, nent, 0
+    )
+    out["fwd_leader"] = jnp.where(pp & ~pok, s.leader, out["fwd_leader"])
+    out["log_full"] = out["log_full"] | (pok & ~room)
+
+    # ---- ReadIndexResp (follower/observer) --------------------------------
+    rir = act & (mtype == MSG.READ_INDEX_RESP) & (is_fol | is_obs)
+    s = s._replace(
+        leader=jnp.where(rir, from_slot + 1, s.leader),
+        election_tick=jnp.where(rir, 0, s.election_tick),
+    )
+    # deliver through the ready queue
+    posm3 = jax.nn.one_hot(s.ri_count, R, dtype=bool) & (
+        rir & (s.ri_count < R)
+    )[:, None]
+    s = s._replace(
+        ri_ctx=jnp.where(posm3, m["hint"][:, None], s.ri_ctx),
+        ri_index=jnp.where(posm3, m["log_index"][:, None], s.ri_index),
+        ri_acks=jnp.where(posm3, jnp.int32(-1), s.ri_acks),
+        ri_count=jnp.where(rir & (s.ri_count < R), s.ri_count + 1, s.ri_count),
+    )
+
+    # ---- LeaderTransfer (leader) ------------------------------------------
+    lt = act & (mtype == MSG.LEADER_TRANSFER) & (s.role == ROLE.LEADER)
+    target = m["hint"]  # slot+1
+    lt_ok = lt & (s.transfer_to == 0) & (target != s.self_slot + 1) & (target != 0)
+    s = s._replace(
+        transfer_to=jnp.where(lt_ok, target, s.transfer_to),
+        election_tick=jnp.where(lt_ok, 0, s.election_tick),
+    )
+    t_oh = jax.nn.one_hot(jnp.maximum(target - 1, 0), P, dtype=bool)
+    t_match = jnp.sum(jnp.where(t_oh, s.match, 0), axis=1)
+    fast = lt_ok & (t_match == s.last_index)
+    out["send_flags"] = jnp.where(
+        fast[:, None] & t_oh, out["send_flags"] | SEND_TIMEOUT_NOW, out["send_flags"]
+    )
+
+    # ---- Unreachable / SnapshotStatus (leader) -----------------------------
+    un = act & (mtype == MSG.UNREACHABLE) & (s.role == ROLE.LEADER) & known_from
+    s = s._replace(
+        rstate=jnp.where(
+            un[:, None] & fr & (s.rstate == RSTATE.REPLICATE), RSTATE.RETRY, s.rstate
+        )
+    )
+    st2 = act & (mtype == MSG.SNAPSHOT_STATUS) & (s.role == ROLE.LEADER) & known_from
+    in_snap = fr & (s.rstate == RSTATE.SNAPSHOT)
+    s = s._replace(
+        snap_sent=jnp.where(
+            st2[:, None] & in_snap & m["reject"][:, None], 0, s.snap_sent
+        ),
+        # becomeWait: next = max(match+1, snap_sent+1), state WAIT
+        next=jnp.where(
+            st2[:, None] & in_snap,
+            jnp.maximum(s.match + 1, s.snap_sent + 1),
+            s.next,
+        ),
+        rstate=jnp.where(st2[:, None] & in_snap, RSTATE.WAIT, s.rstate),
+    )
+
+    resps = {
+        "resp_type": jnp.where(act | noop_resp, resp_type, MSG.NONE),
+        "resp_to": resp_to,
+        "resp_term": s.term,
+        "resp_log_index": resp_log_index,
+        "resp_reject": resp_reject,
+        "resp_hint": resp_hint,
+        "resp_hint2": resp_hint2,
+    }
+    return s, out, resps
+
+
+# ---------------------------------------------------------------------------
+# tick phase
+# ---------------------------------------------------------------------------
+
+
+def _tick(s: RaftTensors, ticks, out):
+    """Advance logical clocks for lanes with ticks > 0 (cf. raft.go:551-629).
+    Multiple coalesced ticks advance timers by that amount, matching the
+    reference's LocalTick coalescing (node.go:1152-1159)."""
+    do = s.active & (ticks > 0)
+    s = s._replace(
+        tick_count=s.tick_count + jnp.where(do, ticks, 0),
+        election_tick=s.election_tick + jnp.where(do, ticks, 0),
+    )
+    is_leader = s.role == ROLE.LEADER
+    # --- non-leader: election timeout
+    can_campaign = (
+        do
+        & ~is_leader
+        & (s.role != ROLE.OBSERVER)
+        & (s.role != ROLE.WITNESS)
+        & (s.election_tick >= s.rand_timeout)
+    )
+    s = s._replace(
+        election_tick=jnp.where(can_campaign, 0, s.election_tick)
+    )
+    s, out = _campaign(s, can_campaign, out, jnp.zeros_like(can_campaign))
+    # --- leader: check quorum + transfer abort at election timeout
+    cq_due = do & is_leader & (s.election_tick >= s.election_timeout)
+    s = s._replace(
+        election_tick=jnp.where(cq_due, 0, s.election_tick),
+        transfer_to=jnp.where(cq_due, 0, s.transfer_to),
+    )
+    active_cnt = jnp.sum((s.ract | _self_mask(s)) & s.voting, axis=1).astype(i32)
+    down = cq_due & s.check_quorum & (active_cnt < _quorum(s))
+    s = s._replace(ract=jnp.where(cq_due[:, None], False, s.ract))
+    s = _become_follower(s, down, s.term, jnp.zeros_like(s.leader))
+    # --- leader: heartbeat timeout
+    is_leader = s.role == ROLE.LEADER
+    s = s._replace(heartbeat_tick=s.heartbeat_tick + jnp.where(do & is_leader, ticks, 0))
+    hb_due = do & is_leader & (s.heartbeat_tick >= s.heartbeat_timeout)
+    s = s._replace(heartbeat_tick=jnp.where(hb_due, 0, s.heartbeat_tick))
+    # heartbeat to voting members; with a pending readindex ctx attach the
+    # newest ctx as hint (raft.go:828-846)
+    R = s.ri_ctx.shape[1]
+    newest_pos = jnp.maximum(s.ri_count - 1, 0)
+    newest_ctx = jnp.take_along_axis(s.ri_ctx, newest_pos[:, None], axis=1)[:, 0]
+    pending = s.ri_count > 0
+    hint = jnp.where(pending, newest_ctx, 0)
+    others_v = s.voting & ~_self_mask(s)
+    obs = s.observer
+    tgt = jnp.where(pending[:, None], others_v, others_v | obs)
+    out["send_flags"] = jnp.where(
+        hb_due[:, None] & tgt, out["send_flags"] | SEND_HEARTBEAT, out["send_flags"]
+    )
+    out["send_hint"] = jnp.where(hb_due[:, None] & tgt, hint[:, None], out["send_hint"])
+    return s, out
+
+
+# ---------------------------------------------------------------------------
+# the step
+# ---------------------------------------------------------------------------
+
+
+def step_batch(
+    s: RaftTensors, inbox: Inbox, ticks: jax.Array, cfg: KernelConfig
+) -> Tuple[RaftTensors, StepOutput]:
+    """One protocol step for all groups: tick + drain K inbox slots + commit
+    + emit engine directives. Jit this (see make_step_fn)."""
+    G, P = s.member.shape
+    K = inbox.mtype.shape[1]
+    R = s.ri_ctx.shape[1]
+
+    prev_term, prev_vote, prev_commit = s.term, s.vote, s.committed
+    save_base_floor = s.unsaved_from
+
+    out = {
+        "send_flags": jnp.zeros((G, P), i32),
+        "send_hint": jnp.zeros((G, P), i32),
+        "noop_appended": jnp.zeros((G,), i32),
+        "dropped_propose": jnp.zeros((G,), i32),
+        "dropped_readindex": jnp.zeros((G,), i32),
+        "dropped_cc": jnp.zeros((G,), bool),
+        "fwd_leader": jnp.zeros((G,), i32),
+        "log_full": jnp.zeros((G,), bool),
+        "force_probe": jnp.zeros((G, P), bool),
+    }
+
+    s, out = _tick(s, ticks, out)
+
+    # drain inbox via scan: iteration k applies slot k for every group
+    def body(carry, slot):
+        s, out = carry
+        m = {
+            "mtype": slot[0],
+            "from_slot": slot[1],
+            "term": slot[2],
+            "log_index": slot[3],
+            "log_term": slot[4],
+            "commit": slot[5],
+            "reject": slot[6].astype(bool),
+            "hint": slot[7],
+            "hint_high": slot[8],
+            "n_entries": slot[9],
+            "entry_terms": slot[10],
+            "entry_cc": slot[11].astype(bool),
+        }
+        s, out, resps = _handle_message(s, m, out, cfg)
+        return (s, out), resps
+
+    E = cfg.max_entries_per_msg
+    hint_high = jnp.zeros_like(inbox.hint)  # reserved (128-bit ctx upper half)
+    slots = (
+        jnp.moveaxis(inbox.mtype, 1, 0),
+        jnp.moveaxis(inbox.from_slot, 1, 0),
+        jnp.moveaxis(inbox.term, 1, 0),
+        jnp.moveaxis(inbox.log_index, 1, 0),
+        jnp.moveaxis(inbox.log_term, 1, 0),
+        jnp.moveaxis(inbox.commit, 1, 0),
+        jnp.moveaxis(inbox.reject.astype(i32), 1, 0),
+        jnp.moveaxis(inbox.hint, 1, 0),
+        jnp.moveaxis(hint_high, 1, 0),
+        jnp.moveaxis(inbox.n_entries, 1, 0),
+        jnp.moveaxis(inbox.entry_terms, 1, 0),
+        jnp.moveaxis(inbox.entry_cc.astype(i32), 1, 0),
+    )
+    (s, out), resps = jax.lax.scan(body, (s, out), slots)
+    resps = {k: jnp.moveaxis(v, 0, 1) for k, v in resps.items()}
+
+    # ---- quorum commit (leader lanes), cf. raft.go:859-907 -----------------
+    is_leader = s.role == ROLE.LEADER
+    nv = _num_voting(s)
+    q = _quorum(s)
+    masked_match = jnp.where(s.voting, s.match, jnp.iinfo(jnp.int32).max)
+    sorted_match = jnp.sort(masked_match, axis=1)  # ascending; non-voting = +inf last
+    # k-th smallest with k = nv - q gives the quorum-replicated index
+    qpos = jnp.clip(nv - q, 0, P - 1)
+    qidx = jnp.take_along_axis(sorted_match, qpos[:, None], axis=1)[:, 0]
+    qterm = _term_at(s, qidx)
+    can_commit = (
+        is_leader & (nv > 0) & (qidx > s.committed) & (qterm == s.term)
+    )
+    s = s._replace(committed=jnp.where(can_commit, qidx, s.committed))
+
+    # ---- replication fan-out ----------------------------------------------
+    # send to every lagging, unpaused peer; optimistically advance next for
+    # peers in REPLICATE state (pipelining, remote.go progress())
+    selfm = _self_mask(s)
+    peer_tgt = s.member & ~selfm
+    lag = s.next <= s.last_index[:, None]
+    # commit advanced this step: also ping up-to-date peers with an empty
+    # Replicate so their commit index stays fresh (the reference gets this
+    # from broadcastReplicateMessage after tryCommit, raft.go:1675-1677)
+    commit_moved = (s.committed != prev_commit)[:, None]
+    paused = (s.rstate == RSTATE.WAIT) | (s.rstate == RSTATE.SNAPSHOT)
+    # peers whose next has been compacted away need a snapshot (host path)
+    compacted = s.next < s.first_index[:, None]
+    send = (
+        is_leader[:, None]
+        & peer_tgt
+        & (lag | commit_moved | out["force_probe"])
+        & ~paused
+        & ~compacted
+    )
+    need_snap = is_leader[:, None] & peer_tgt & lag & ~paused & compacted & s.ract
+    n_send = jnp.clip(s.last_index[:, None] - s.next + 1, 0, E)
+    prev_idx = s.next - 1
+    W = s.log_term.shape[1]
+    prev_term_pp = jnp.where(
+        prev_idx == s.first_index[:, None] - 1,
+        s.marker_term[:, None],
+        jnp.take_along_axis(s.log_term, prev_idx % W, axis=1),
+    )
+    out["send_flags"] = jnp.where(
+        send, out["send_flags"] | SEND_REPLICATE, out["send_flags"]
+    )
+    out["send_flags"] = jnp.where(
+        need_snap, out["send_flags"] | NEED_SNAPSHOT, out["send_flags"]
+    )
+    s = s._replace(
+        snap_sent=jnp.where(need_snap, s.last_index[:, None], s.snap_sent),
+        rstate=jnp.where(need_snap, RSTATE.SNAPSHOT, s.rstate),
+    )
+    send_prev_index = jnp.where(send, prev_idx, 0)
+    send_n = jnp.where(send, n_send, 0)
+    # optimistic next advance (REPLICATE state); a RETRY probe carrying
+    # entries transitions to WAIT until acked (remote.go progress()); empty
+    # commit-refresh sends leave flow-control state untouched
+    adv = send & (s.rstate == RSTATE.REPLICATE) & (n_send > 0)
+    probe = send & (s.rstate == RSTATE.RETRY) & (n_send > 0)
+    s = s._replace(
+        next=jnp.where(adv, s.next + n_send, s.next),
+        rstate=jnp.where(probe, RSTATE.WAIT, s.rstate),
+    )
+    send_commit = jnp.where(send, s.committed[:, None], 0)
+    send_hb_commit = jnp.minimum(s.match, s.committed[:, None])
+
+    # ---- readindex ready queue pop ----------------------------------------
+    # ack bits only ever come from voting peers' HeartbeatResp; +1 counts the
+    # leader itself. acks == -1 marks an immediately-ready entry.
+    acks = s.ri_acks
+    popc = _popcount(acks)
+    confirmed = (popc + 1 >= q[:, None]) | (acks == -1)
+    live = (jnp.arange(R, dtype=i32)[None, :] < s.ri_count[:, None]) & (
+        s.ri_ctx != 0
+    )
+    confirmed = confirmed & live
+    # pop the longest confirmed prefix... any confirmed slot releases all
+    # earlier slots (readindex.go:77-116)
+    idxs = jnp.arange(R, dtype=i32)[None, :]
+    last_conf = jnp.max(jnp.where(confirmed, idxs + 1, 0), axis=1)  # count to pop
+    popmask = idxs < last_conf[:, None]
+    ready_ctx = jnp.where(popmask, s.ri_ctx, 0)
+    # released entries read at the confirming slot's index
+    conf_idx = jnp.max(jnp.where(confirmed, s.ri_index, 0), axis=1)
+    ready_index = jnp.where(popmask, jnp.minimum(s.ri_index, conf_idx[:, None]), 0)
+    ready_count = last_conf
+    # compact the queue
+    shift = last_conf
+    new_pos = idxs - shift[:, None]
+    def shift_left(a, fill):
+        take = jnp.clip(idxs + shift[:, None], 0, R - 1)
+        v = jnp.take_along_axis(a, take, axis=1)
+        return jnp.where(idxs < (s.ri_count - shift)[:, None], v, fill)
+    s = s._replace(
+        ri_ctx=shift_left(s.ri_ctx, 0),
+        ri_index=shift_left(s.ri_index, 0),
+        ri_acks=shift_left(s.ri_acks, 0),
+        ri_count=s.ri_count - shift,
+    )
+
+    # ---- engine directives -------------------------------------------------
+    save_from = jnp.minimum(save_base_floor, s.unsaved_from)
+    has_save = s.last_index >= save_from
+    out_save_from = jnp.where(has_save & s.active, save_from, 0)
+    out_save_to = jnp.where(has_save & s.active, s.last_index, 0)
+    s = s._replace(unsaved_from=s.last_index + 1)
+
+    apply_from = s.processed + 1
+    apply_to = s.committed
+    has_apply = apply_to >= apply_from
+    out_apply_from = jnp.where(has_apply & s.active, apply_from, 0)
+    out_apply_to = jnp.where(has_apply & s.active, apply_to, 0)
+    s = s._replace(processed=jnp.maximum(s.processed, s.committed))
+    # entries handed to the engine are applied synchronously by the engine
+    # loop this round; mirror the reference's applied cursor via engine
+    # notifications (host may override through reconcile).
+    s = s._replace(applied=jnp.maximum(s.applied, out_apply_to))
+
+    hard_changed = (
+        (s.term != prev_term) | (s.vote != prev_vote) | (s.committed != prev_commit)
+    )
+
+    last_term_out = _term_at(s, s.last_index)
+
+    output = StepOutput(
+        send_flags=out["send_flags"] * s.active[:, None],
+        send_prev_index=send_prev_index,
+        send_prev_term=jnp.where(send, prev_term_pp, 0),
+        send_n_entries=send_n,
+        send_commit=send_commit,
+        send_hb_commit=send_hb_commit,
+        send_hint=out["send_hint"],
+        vote_last_index=s.last_index,
+        vote_last_term=last_term_out,
+        resp_type=resps["resp_type"],
+        resp_to=resps["resp_to"],
+        resp_term=resps["resp_term"],
+        resp_log_index=resps["resp_log_index"],
+        resp_reject=resps["resp_reject"],
+        resp_hint=resps["resp_hint"],
+        resp_hint2=resps["resp_hint2"],
+        save_from=out_save_from,
+        save_to=out_save_to,
+        apply_from=out_apply_from,
+        apply_to=out_apply_to,
+        commit_index=s.committed,
+        hard_changed=hard_changed & s.active,
+        ready_ctx=ready_ctx,
+        ready_index=ready_index,
+        ready_count=ready_count * s.active,
+        dropped_propose=out["dropped_propose"],
+        dropped_cc=out["dropped_cc"],
+        fwd_leader=out["fwd_leader"],
+        noop_appended=out["noop_appended"],
+        log_full=out["log_full"],
+    )
+    return s, output
+
+
+def _popcount(x):
+    return jax.lax.population_count(x.astype(jnp.uint32)).astype(i32)
+
+
+@functools.lru_cache(maxsize=32)
+def make_step_fn(cfg: KernelConfig, donate: bool = True):
+    """Return a jitted step(state, inbox, ticks) -> (state, output).
+    Cached per (cfg, donate) so every engine/cluster with the same static
+    shapes shares one compiled executable."""
+    f = functools.partial(step_batch, cfg=cfg)
+    if donate:
+        return jax.jit(f, donate_argnums=(0,))
+    return jax.jit(f)

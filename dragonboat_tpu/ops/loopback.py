@@ -1,0 +1,313 @@
+"""In-process multi-replica simulation over the vectorized kernel.
+
+Runs N kernel instances (one per simulated NodeHost; replica h owns peer
+slot h of every group) and routes StepOutput send-descriptors/responses into
+the peers' inboxes each round. This is the kernel-level analogue of the
+reference's in-memory multi-peer raft tests (internal/raft/raft_test.go) and
+the template for the real engine's message routing.
+
+Everything here is host-side numpy; it exists for correctness testing and
+simulation, not performance.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .state import (
+    MSG,
+    NEED_SNAPSHOT,
+    ROLE,
+    SEND_HEARTBEAT,
+    SEND_REPLICATE,
+    SEND_TIMEOUT_NOW,
+    SEND_VOTE_REQ,
+    Inbox,
+    KernelConfig,
+    RaftTensors,
+    configure_group,
+    init_state,
+)
+from .kernel import make_step_fn
+
+
+@dataclass
+class Msg:
+    """Host-side message record (the loopback 'wire' format)."""
+
+    mtype: int
+    from_slot: int
+    term: int = 0
+    log_index: int = 0
+    log_term: int = 0
+    commit: int = 0
+    reject: bool = False
+    hint: int = 0
+    n_entries: int = 0
+    entry_terms: Tuple[int, ...] = ()
+    entry_cc: Tuple[bool, ...] = ()
+
+
+class LoopbackCluster:
+    def __init__(
+        self,
+        n_replicas: int = 3,
+        n_groups: int = 2,
+        cfg: Optional[KernelConfig] = None,
+        election: int = 10,
+        heartbeat: int = 2,
+        check_quorum: bool = False,
+        witnesses: Tuple[int, ...] = (),
+        observers: Tuple[int, ...] = (),
+        seed: int = 1,
+    ) -> None:
+        self.cfg = cfg or KernelConfig(
+            groups=n_groups, peers=max(n_replicas, 2), inbox_depth=8
+        )
+        assert n_replicas <= self.cfg.peers
+        self.n_replicas = n_replicas
+        self.n_groups = n_groups
+        self.step_fn = make_step_fn(self.cfg, donate=False)
+        voting = [r for r in range(n_replicas) if r not in observers]
+        self.states: List[RaftTensors] = []
+        for h in range(n_replicas):
+            st = init_state(self.cfg)
+            st = st._replace(seed=st.seed + np.uint32(seed * 7919))
+            for g in range(n_groups):
+                st = configure_group(
+                    st,
+                    g,
+                    self_slot=h,
+                    voting_slots=[v for v in voting if v not in witnesses],
+                    observer_slots=list(observers),
+                    witness_slots=list(witnesses),
+                    election_timeout=election,
+                    heartbeat_timeout=heartbeat,
+                    check_quorum=check_quorum,
+                    is_observer=h in observers,
+                    is_witness=h in witnesses,
+                )
+            self.states.append(st)
+        # pending[replica][group] = list of Msg
+        self.pending: List[List[List[Msg]]] = [
+            [[] for _ in range(n_groups)] for _ in range(n_replicas)
+        ]
+        self.dropped_links: set = set()  # (from_replica, to_replica)
+        self.isolated: set = set()
+        # observed engine directives per replica for assertions
+        self.last_outputs = [None] * n_replicas
+        self.saved: List[Dict[int, int]] = [dict() for _ in range(n_replicas)]
+        self.ready_reads: List[List[Tuple[int, int, int]]] = [
+            [] for _ in range(n_replicas)
+        ]
+        self.snapshot_requests: List[Tuple[int, int, int]] = []
+
+    # ------------------------------------------------------------ injection
+    def propose(self, replica: int, group: int, n: int = 1, cc_first: bool = False):
+        # config changes ship alone (kernel host invariant)
+        assert not (cc_first and n != 1), "config change must be a lone entry"
+        cc = tuple(cc_first if i == 0 else False for i in range(n))
+        self.pending[replica][group].append(
+            Msg(MSG.PROPOSE, from_slot=replica, n_entries=n, entry_cc=cc)
+        )
+
+    def read_index(self, replica: int, group: int, ctx: int):
+        self.pending[replica][group].append(
+            Msg(MSG.READ_INDEX, from_slot=replica, hint=ctx)
+        )
+
+    def transfer_leader(self, replica: int, group: int, target_slot: int):
+        self.pending[replica][group].append(
+            Msg(MSG.LEADER_TRANSFER, from_slot=replica, hint=target_slot + 1)
+        )
+
+    # ------------------------------------------------------------ stepping
+    def _pack_inbox(self, replica: int) -> Inbox:
+        cfg = self.cfg
+        G, K, E = cfg.groups, cfg.inbox_depth, cfg.max_entries_per_msg
+        mtype = np.full((G, K), MSG.NONE, np.int32)
+        arr = {
+            "from_slot": np.zeros((G, K), np.int32),
+            "term": np.zeros((G, K), np.int32),
+            "log_index": np.zeros((G, K), np.int32),
+            "log_term": np.zeros((G, K), np.int32),
+            "commit": np.zeros((G, K), np.int32),
+            "reject": np.zeros((G, K), bool),
+            "hint": np.zeros((G, K), np.int32),
+            "n_entries": np.zeros((G, K), np.int32),
+        }
+        eterms = np.zeros((G, K, E), np.int32)
+        ecc = np.zeros((G, K, E), bool)
+        for g in range(self.n_groups):
+            q = self.pending[replica][g]
+            take = q[:K]
+            self.pending[replica][g] = q[K:]
+            for k, m in enumerate(take):
+                mtype[g, k] = m.mtype
+                arr["from_slot"][g, k] = m.from_slot
+                arr["term"][g, k] = m.term
+                arr["log_index"][g, k] = m.log_index
+                arr["log_term"][g, k] = m.log_term
+                arr["commit"][g, k] = m.commit
+                arr["reject"][g, k] = m.reject
+                arr["hint"][g, k] = m.hint
+                arr["n_entries"][g, k] = m.n_entries
+                for e, t in enumerate(m.entry_terms[:E]):
+                    eterms[g, k, e] = t
+                for e, c in enumerate(m.entry_cc[:E]):
+                    ecc[g, k, e] = c
+        return Inbox(
+            mtype=jnp.asarray(mtype),
+            from_slot=jnp.asarray(arr["from_slot"]),
+            term=jnp.asarray(arr["term"]),
+            log_index=jnp.asarray(arr["log_index"]),
+            log_term=jnp.asarray(arr["log_term"]),
+            commit=jnp.asarray(arr["commit"]),
+            reject=jnp.asarray(arr["reject"]),
+            hint=jnp.asarray(arr["hint"]),
+            n_entries=jnp.asarray(arr["n_entries"]),
+            entry_terms=jnp.asarray(eterms),
+            entry_cc=jnp.asarray(ecc),
+        )
+
+    def _route(self, h: int, out, state: RaftTensors) -> None:
+        """Convert replica h's StepOutput into peer inbox messages."""
+        cfg = self.cfg
+        term = np.asarray(state.term)
+        ring = np.asarray(state.log_term)
+        ring_cc = np.asarray(state.log_is_cc)
+        W = cfg.log_window
+        flags = np.asarray(out.send_flags)
+        prev_i = np.asarray(out.send_prev_index)
+        prev_t = np.asarray(out.send_prev_term)
+        n_ent = np.asarray(out.send_n_entries)
+        commit = np.asarray(out.send_commit)
+        hb_commit = np.asarray(out.send_hb_commit)
+        hint = np.asarray(out.send_hint)
+        v_li = np.asarray(out.vote_last_index)
+        v_lt = np.asarray(out.vote_last_term)
+        rtype = np.asarray(out.resp_type)
+        rto = np.asarray(out.resp_to)
+        rterm = np.asarray(out.resp_term)
+        rli = np.asarray(out.resp_log_index)
+        rrej = np.asarray(out.resp_reject)
+        rhint = np.asarray(out.resp_hint)
+        ready_ctx = np.asarray(out.ready_ctx)
+        ready_idx = np.asarray(out.ready_index)
+        ready_n = np.asarray(out.ready_count)
+        for g in range(self.n_groups):
+            for n in range(int(ready_n[g])):
+                self.ready_reads[h].append((g, int(ready_ctx[g, n]), int(ready_idx[g, n])))
+            for p in range(self.n_replicas):
+                if p == h:
+                    continue
+                f = int(flags[g, p])
+                if f & SEND_REPLICATE:
+                    n = int(n_ent[g, p])
+                    base = int(prev_i[g, p]) + 1
+                    ets = tuple(int(ring[g, (base + e) % W]) for e in range(n))
+                    ecc = tuple(bool(ring_cc[g, (base + e) % W]) for e in range(n))
+                    self._deliver(
+                        h, p, g,
+                        Msg(
+                            MSG.REPLICATE, from_slot=h, term=int(term[g]),
+                            log_index=int(prev_i[g, p]), log_term=int(prev_t[g, p]),
+                            commit=int(commit[g, p]), n_entries=n,
+                            entry_terms=ets, entry_cc=ecc,
+                        ),
+                    )
+                if f & SEND_HEARTBEAT:
+                    self._deliver(
+                        h, p, g,
+                        Msg(
+                            MSG.HEARTBEAT, from_slot=h, term=int(term[g]),
+                            commit=int(hb_commit[g, p]), hint=int(hint[g, p]),
+                        ),
+                    )
+                if f & SEND_VOTE_REQ:
+                    self._deliver(
+                        h, p, g,
+                        Msg(
+                            MSG.REQUEST_VOTE, from_slot=h, term=int(term[g]),
+                            log_index=int(v_li[g]), log_term=int(v_lt[g]),
+                            hint=int(hint[g, p]),
+                        ),
+                    )
+                if f & SEND_TIMEOUT_NOW:
+                    self._deliver(
+                        h, p, g,
+                        Msg(MSG.TIMEOUT_NOW, from_slot=h, term=int(term[g])),
+                    )
+                if f & NEED_SNAPSHOT:
+                    self.snapshot_requests.append((h, g, p))
+            K = rtype.shape[1]
+            for k in range(K):
+                t = int(rtype[g, k])
+                if t == MSG.NONE:
+                    continue
+                self._deliver(
+                    h, int(rto[g, k]), g,
+                    Msg(
+                        t, from_slot=h, term=int(rterm[g, k]),
+                        log_index=int(rli[g, k]), reject=bool(rrej[g, k]),
+                        hint=int(rhint[g, k]),
+                    ),
+                )
+
+    def _deliver(self, frm: int, to: int, g: int, m: Msg) -> None:
+        if to >= self.n_replicas:
+            return
+        if (frm, to) in self.dropped_links:
+            return
+        if frm in self.isolated or to in self.isolated:
+            return
+        self.pending[to][g].append(m)
+
+    def step(self, tick: bool = True) -> None:
+        """One simulation round: every replica consumes its inbox (+optional
+        tick), then outputs are routed."""
+        outs = []
+        for h in range(self.n_replicas):
+            inbox = self._pack_inbox(h)
+            ticks = jnp.full((self.cfg.groups,), 1 if tick else 0, jnp.int32)
+            st, out = self.step_fn(self.states[h], inbox, ticks)
+            self.states[h] = st
+            outs.append(out)
+            self.last_outputs[h] = out
+        for h in range(self.n_replicas):
+            self._route(h, outs[h], self.states[h])
+
+    def settle(self, rounds: int = 20) -> None:
+        """Drain message queues without ticking."""
+        for _ in range(rounds):
+            if not any(
+                self.pending[h][g]
+                for h in range(self.n_replicas)
+                for g in range(self.n_groups)
+            ):
+                return
+            self.step(tick=False)
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            self.step(tick=True)
+            self.settle()
+
+    # ------------------------------------------------------------ inspection
+    def roles(self, g: int = 0) -> List[int]:
+        return [int(np.asarray(st.role)[g]) for st in self.states]
+
+    def leader_of(self, g: int = 0) -> Optional[int]:
+        ls = [h for h, st in enumerate(self.states) if int(np.asarray(st.role)[g]) == ROLE.LEADER]
+        return ls[0] if len(ls) == 1 else None
+
+    def field(self, name: str, g: int = 0) -> List[int]:
+        return [int(np.asarray(getattr(st, name))[g]) for st in self.states]
+
+    def ring_terms(self, h: int, g: int, lo: int, hi: int) -> List[int]:
+        W = self.cfg.log_window
+        ring = np.asarray(self.states[h].log_term)
+        return [int(ring[g, i % W]) for i in range(lo, hi + 1)]

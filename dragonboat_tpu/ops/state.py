@@ -1,0 +1,364 @@
+"""Device state layout for the vectorized Raft kernel.
+
+All protocol state lives in int32/bool struct-of-arrays over a fixed
+(G groups, P peers) shape. Node identity on device is the *peer slot*
+(0..P-1); the host keeps the slot <-> 64-bit node-id mapping per group.
+Vote/leader fields store slot+1 with 0 meaning "none".
+
+Log entries never carry payloads on device: the ring buffer log_term[G, W]
+holds per-entry term metadata only (slot = index % W), mirroring how the
+reference's raft core only needs (index, term) pairs for the protocol while
+payload bytes flow host-side (cf. internal/raft/logentry.go). Indexes are
+int32 *rebased* values: the host owns a 64-bit base per group and calls
+`rebase` before any index nears 2**31.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ROLE:
+    """Replica roles; values match core.raft.RaftNodeState / reference
+    raft.go:63-70."""
+
+    FOLLOWER = 0
+    CANDIDATE = 1
+    LEADER = 2
+    OBSERVER = 3
+    WITNESS = 4
+
+
+class RSTATE:
+    """Per-follower flow control FSM (cf. internal/raft/remote.go:44-49)."""
+
+    RETRY = 0
+    WAIT = 1
+    REPLICATE = 2
+    SNAPSHOT = 3
+
+
+class MSG:
+    """Kernel message types. Values match types.MessageType for the wire
+    types; local/engine-only types reuse the same numbering."""
+
+    NONE = -1  # empty inbox slot
+    LOCAL_TICK = 0
+    ELECTION = 1
+    LEADER_HEARTBEAT = 2
+    NOOP = 4
+    PROPOSE = 7
+    SNAPSHOT_STATUS = 8
+    UNREACHABLE = 9
+    CHECK_QUORUM = 10
+    REPLICATE = 12
+    REPLICATE_RESP = 13
+    REQUEST_VOTE = 14
+    REQUEST_VOTE_RESP = 15
+    INSTALL_SNAPSHOT = 16
+    HEARTBEAT = 17
+    HEARTBEAT_RESP = 18
+    READ_INDEX = 19
+    READ_INDEX_RESP = 20
+    LEADER_TRANSFER = 23
+    TIMEOUT_NOW = 24
+
+
+# send_flags bits in StepOutput
+SEND_REPLICATE = 1
+SEND_HEARTBEAT = 2
+SEND_VOTE_REQ = 4
+SEND_TIMEOUT_NOW = 8
+NEED_SNAPSHOT = 16
+
+
+class KernelConfig(NamedTuple):
+    """Static shape configuration compiled into the kernel."""
+
+    groups: int = 1024  # G
+    peers: int = 8  # P (max replicas per group incl. observers/witnesses)
+    log_window: int = 512  # W (device-resident per-group log metadata window)
+    inbox_depth: int = 8  # K (messages consumed per group per step)
+    max_entries_per_msg: int = 8  # E (entries attached to one Replicate)
+    readindex_depth: int = 4  # R (outstanding ReadIndex ctx per group)
+
+
+class RaftTensors(NamedTuple):
+    """The complete protocol state of G groups as tensors."""
+
+    # identity / membership
+    active: jax.Array  # bool[G] lane holds a live replica
+    self_slot: jax.Array  # i32[G] this replica's peer slot
+    member: jax.Array  # bool[G,P] slot holds any member
+    voting: jax.Array  # bool[G,P] slot is a voting member (full or witness)
+    observer: jax.Array  # bool[G,P]
+    witness: jax.Array  # bool[G,P]
+    # durable raft state
+    term: jax.Array  # i32[G]
+    vote: jax.Array  # i32[G] slot+1, 0=none
+    # volatile role state
+    role: jax.Array  # i32[G] ROLE.*
+    leader: jax.Array  # i32[G] slot+1, 0=none
+    # timers (ticks)
+    tick_count: jax.Array  # i32[G]
+    election_tick: jax.Array  # i32[G]
+    heartbeat_tick: jax.Array  # i32[G]
+    rand_timeout: jax.Array  # i32[G] randomized election timeout
+    election_timeout: jax.Array  # i32[G] per-group config
+    heartbeat_timeout: jax.Array  # i32[G]
+    check_quorum: jax.Array  # bool[G]
+    # log metadata (rebased int32 indexes)
+    first_index: jax.Array  # i32[G] lowest index with term in the ring
+    marker_term: jax.Array  # i32[G] term at first_index-1 (snapshot/compaction marker)
+    last_index: jax.Array  # i32[G]
+    committed: jax.Array  # i32[G]
+    processed: jax.Array  # i32[G] committed entries already handed to engine
+    applied: jax.Array  # i32[G] applied index confirmed by the RSM
+    unsaved_from: jax.Array  # i32[G] first index not yet persisted by engine
+    log_term: jax.Array  # i32[G,W] ring: term of entry at index i in slot i%W
+    log_is_cc: jax.Array  # bool[G,W] ring: entry is a config change
+    # leader replication bookkeeping (cf. remote.go)
+    match: jax.Array  # i32[G,P]
+    next: jax.Array  # i32[G,P]
+    rstate: jax.Array  # i32[G,P] RSTATE.*
+    ract: jax.Array  # bool[G,P] active flag for check-quorum
+    snap_sent: jax.Array  # i32[G,P] pending snapshot index per peer
+    # election bookkeeping
+    vresp: jax.Array  # bool[G,P] peer responded to vote request
+    vgrant: jax.Array  # bool[G,P] peer granted vote
+    # leadership transfer
+    transfer_to: jax.Array  # i32[G] slot+1, 0=none
+    transfer_flag: jax.Array  # bool[G] this node is a sanctioned transfer target
+    # membership change guard
+    pending_cc: jax.Array  # bool[G] uncommitted config change in flight
+    # read index queue (FIFO of R slots, ctx 0 = empty)
+    ri_ctx: jax.Array  # i32[G,R]
+    ri_index: jax.Array  # i32[G,R]
+    ri_acks: jax.Array  # i32[G,R] bitmask of peer slots that acked
+    ri_count: jax.Array  # i32[G] live queue length
+    # randomness
+    seed: jax.Array  # u32[G]
+
+
+class Inbox(NamedTuple):
+    """K inbound messages per group per step; empty slots have mtype NONE.
+
+    Replicate messages carry up to E (term, is_cc) metadata pairs for their
+    entries; payload bytes stay host-side keyed by (group, index)."""
+
+    mtype: jax.Array  # i32[G,K]
+    from_slot: jax.Array  # i32[G,K]
+    term: jax.Array  # i32[G,K]
+    log_index: jax.Array  # i32[G,K]
+    log_term: jax.Array  # i32[G,K]
+    commit: jax.Array  # i32[G,K]
+    reject: jax.Array  # bool[G,K]
+    hint: jax.Array  # i32[G,K]
+    n_entries: jax.Array  # i32[G,K]
+    entry_terms: jax.Array  # i32[G,K,E]
+    entry_cc: jax.Array  # bool[G,K,E]
+
+
+class StepOutput(NamedTuple):
+    """Per-step engine directives; the host materializes real messages from
+    the [G,P] descriptor plane plus its payload arenas."""
+
+    # broadcast/send plane
+    send_flags: jax.Array  # i32[G,P] bitmask SEND_*
+    send_prev_index: jax.Array  # i32[G,P] Replicate: prev log index (next-1)
+    send_prev_term: jax.Array  # i32[G,P] Replicate: term at prev
+    send_n_entries: jax.Array  # i32[G,P] Replicate: entries to attach
+    send_commit: jax.Array  # i32[G,P] Replicate commit index
+    # Heartbeat commit is capped at min(match, committed) per peer so a
+    # lagging follower never commits a divergent suffix (cf. raft.go:810-816)
+    send_hb_commit: jax.Array  # i32[G,P]
+    send_hint: jax.Array  # i32[G,P] readindex ctx (heartbeat) / transfer hint
+    vote_last_index: jax.Array  # i32[G] RequestVote: candidate last log index
+    vote_last_term: jax.Array  # i32[G]
+    # response plane: one reply per consumed inbox slot
+    resp_type: jax.Array  # i32[G,K] MSG.* or NONE
+    resp_to: jax.Array  # i32[G,K] peer slot
+    resp_term: jax.Array  # i32[G,K]
+    resp_log_index: jax.Array  # i32[G,K]
+    resp_reject: jax.Array  # bool[G,K]
+    resp_hint: jax.Array  # i32[G,K]
+    resp_hint2: jax.Array  # i32[G,K] (hint_high echo for readindex)
+    # engine directives
+    save_from: jax.Array  # i32[G] first entry to persist (0 = nothing)
+    save_to: jax.Array  # i32[G] last entry to persist
+    apply_from: jax.Array  # i32[G] committed entries to hand to the RSM
+    apply_to: jax.Array  # i32[G]
+    commit_index: jax.Array  # i32[G] (for hard-state persistence)
+    hard_changed: jax.Array  # bool[G] term/vote/commit changed this step
+    ready_ctx: jax.Array  # i32[G,R] confirmed readindex contexts
+    ready_index: jax.Array  # i32[G,R]
+    ready_count: jax.Array  # i32[G]
+    dropped_propose: jax.Array  # i32[G] proposals dropped (no leader etc.)
+    dropped_cc: jax.Array  # bool[G] config-change replaced (pending invariant)
+    fwd_leader: jax.Array  # i32[G] slot+1 to forward host proposals to
+    noop_appended: jax.Array  # i32[G] index of new-leader noop entry (0=none)
+    log_full: jax.Array  # bool[G] window exhausted; engine must snapshot
+
+
+def init_state(cfg: KernelConfig) -> RaftTensors:
+    G, P, W, R = cfg.groups, cfg.peers, cfg.log_window, cfg.readindex_depth
+    i32 = jnp.int32
+    z_g = jnp.zeros((G,), i32)
+    z_gp = jnp.zeros((G, P), i32)
+    f_g = jnp.zeros((G,), bool)
+    f_gp = jnp.zeros((G, P), bool)
+    return RaftTensors(
+        active=f_g,
+        self_slot=z_g,
+        member=f_gp,
+        voting=f_gp,
+        observer=f_gp,
+        witness=f_gp,
+        term=z_g,
+        vote=z_g,
+        role=z_g,
+        leader=z_g,
+        tick_count=z_g,
+        election_tick=z_g,
+        heartbeat_tick=z_g,
+        rand_timeout=jnp.full((G,), 10, i32),
+        election_timeout=jnp.full((G,), 10, i32),
+        heartbeat_timeout=jnp.full((G,), 1, i32),
+        check_quorum=f_g,
+        first_index=jnp.ones((G,), i32),
+        marker_term=z_g,
+        last_index=z_g,
+        committed=z_g,
+        processed=z_g,
+        applied=z_g,
+        unsaved_from=jnp.ones((G,), i32),
+        log_term=jnp.zeros((G, W), i32),
+        log_is_cc=jnp.zeros((G, W), bool),
+        match=z_gp,
+        next=jnp.ones((G, P), i32),
+        rstate=z_gp,
+        ract=f_gp,
+        snap_sent=z_gp,
+        vresp=f_gp,
+        vgrant=f_gp,
+        transfer_to=z_g,
+        transfer_flag=f_g,
+        pending_cc=f_g,
+        ri_ctx=jnp.zeros((G, R), i32),
+        ri_index=jnp.zeros((G, R), i32),
+        ri_acks=jnp.zeros((G, R), i32),
+        ri_count=z_g,
+        seed=jnp.arange(1, G + 1, dtype=jnp.uint32) * jnp.uint32(2654435761),
+    )
+
+
+def make_empty_inbox(cfg: KernelConfig) -> Inbox:
+    G, K, E = cfg.groups, cfg.inbox_depth, cfg.max_entries_per_msg
+    i32 = jnp.int32
+    return Inbox(
+        mtype=jnp.full((G, K), MSG.NONE, i32),
+        from_slot=jnp.zeros((G, K), i32),
+        term=jnp.zeros((G, K), i32),
+        log_index=jnp.zeros((G, K), i32),
+        log_term=jnp.zeros((G, K), i32),
+        commit=jnp.zeros((G, K), i32),
+        reject=jnp.zeros((G, K), bool),
+        hint=jnp.zeros((G, K), i32),
+        n_entries=jnp.zeros((G, K), i32),
+        entry_terms=jnp.zeros((G, K, E), i32),
+        entry_cc=jnp.zeros((G, K, E), bool),
+    )
+
+
+# ---------------------------------------------------------------- host side
+
+
+def configure_group(
+    state: RaftTensors,
+    g: int,
+    self_slot: int,
+    voting_slots,
+    observer_slots=(),
+    witness_slots=(),
+    election_timeout: int = 10,
+    heartbeat_timeout: int = 1,
+    check_quorum: bool = False,
+    is_observer: bool = False,
+    is_witness: bool = False,
+) -> RaftTensors:
+    """Host-side reconcile: activate lane g with the given membership.
+    Rare-path (StartCluster / config change), so clarity over speed."""
+    P = state.member.shape[1]
+    member = np.array(state.member[g])
+    voting = np.array(state.voting[g])
+    observer = np.array(state.observer[g])
+    witness = np.array(state.witness[g])
+    member[:] = False
+    voting[:] = False
+    observer[:] = False
+    witness[:] = False
+    for s in voting_slots:
+        member[s] = True
+        voting[s] = True
+    for s in observer_slots:
+        member[s] = True
+        observer[s] = True
+    for s in witness_slots:
+        member[s] = True
+        voting[s] = True
+        witness[s] = True
+    role = (
+        ROLE.OBSERVER if is_observer else ROLE.WITNESS if is_witness else ROLE.FOLLOWER
+    )
+    upd = {
+        "active": state.active.at[g].set(True),
+        "self_slot": state.self_slot.at[g].set(self_slot),
+        "member": state.member.at[g].set(jnp.asarray(member)),
+        "voting": state.voting.at[g].set(jnp.asarray(voting)),
+        "observer": state.observer.at[g].set(jnp.asarray(observer)),
+        "witness": state.witness.at[g].set(jnp.asarray(witness)),
+        "role": state.role.at[g].set(role),
+        "election_timeout": state.election_timeout.at[g].set(election_timeout),
+        "heartbeat_timeout": state.heartbeat_timeout.at[g].set(heartbeat_timeout),
+        "rand_timeout": state.rand_timeout.at[g].set(
+            election_timeout
+            + _mix(int(np.asarray(state.seed)[g]), 0, self_slot) % election_timeout
+        ),
+        "check_quorum": state.check_quorum.at[g].set(check_quorum),
+    }
+    return state._replace(**upd)
+
+
+def _mix(a, b, c):
+    """Cheap deterministic integer mix (xorshift-multiply), used for
+    randomized election timeouts; must match kernel._mix (uint32 wraparound
+    done in Python ints to avoid numpy overflow warnings)."""
+    M = 0xFFFFFFFF
+    x = ((int(a) * 2654435761) ^ (int(b) * 40503) ^ (int(c) * 2246822519)) & M
+    x ^= x >> 15
+    x = (x * 2246822519) & M
+    x ^= x >> 13
+    return x
+
+
+def rebase(state: RaftTensors, delta) -> RaftTensors:
+    """Subtract delta[G] from every index-valued tensor. The host calls this
+    (through the engine) before any rebased index nears 2**31; ring slots are
+    invariant when delta % W == 0."""
+    d = jnp.asarray(delta, jnp.int32)
+    dp = d[:, None]
+    return state._replace(
+        first_index=state.first_index - d,
+        last_index=state.last_index - d,
+        committed=state.committed - d,
+        processed=state.processed - d,
+        applied=state.applied - d,
+        unsaved_from=state.unsaved_from - d,
+        match=jnp.maximum(state.match - dp, 0),
+        next=jnp.maximum(state.next - dp, 1),
+        snap_sent=jnp.maximum(state.snap_sent - dp, 0),
+        ri_index=jnp.maximum(state.ri_index - dp, 0),
+    )

@@ -187,11 +187,12 @@ class Entry:
         return len(self.cmd) == 0
 
 
-# Special client session series ids (cf. client/session.go:23-43).
+# Special client session series ids (cf. client/session.go:29-43:
+# register = MaxUint64-1, unregister = MaxUint64).
 NOOP_CLIENT_ID = 0
 NOOP_SERIES_ID = 0
-SERIES_ID_FOR_REGISTER = 2**64 - 1
-SERIES_ID_FOR_UNREGISTER = 2**64 - 2
+SERIES_ID_FOR_REGISTER = 2**64 - 2
+SERIES_ID_FOR_UNREGISTER = 2**64 - 1
 SERIES_ID_FIRST_PROPOSAL = 1
 
 

@@ -1,10 +1,23 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so that
-multi-chip sharding paths are exercised without TPU hardware."""
+multi-chip sharding paths are exercised without TPU hardware.
+
+The environment auto-imports jax via a sitecustomize hook and registers an
+'axon' TPU-tunnel backend whose client creation can hang when the tunnel is
+busy. Tests must be hermetic and CPU-only, so before any backend is
+initialized we (a) request the cpu platform, (b) drop the axon backend
+factory, and (c) size the host platform to 8 virtual devices."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - plugin absent outside this image
+    pass

@@ -1,0 +1,179 @@
+"""Differential testing: the vectorized kernel against the scalar oracle.
+
+Both implementations run the same 3-replica scenario in lockstep rounds
+(tick-all, then deliver to quiescence). The scalar side's randomized election
+timeout is patched to the kernel's deterministic (seed, term, slot) hash, so
+elections resolve identically; after every round the protocol observables —
+role, term, leader, commit index, last log index, and per-entry log terms —
+must agree replica-for-replica. This mirrors the reference's use of the etcd
+test suites as a second implementation to diff against (docs/test.md:4), with
+the scalar core as the oracle (SURVEY.md §4 implication note)."""
+import numpy as np
+import pytest
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import InMemLogDB
+from dragonboat_tpu.core.raft import Raft, RaftNodeState
+from dragonboat_tpu.core.remote import Remote
+from dragonboat_tpu.ops.loopback import LoopbackCluster
+from dragonboat_tpu.ops.state import _mix
+from dragonboat_tpu.types import Entry, Message, MessageType, is_local_message
+
+MT = MessageType
+N = 3
+ELECTION = 10
+HEARTBEAT = 2
+
+
+class ScalarCluster:
+    """Scalar oracle wired to the kernel's timeout derivation and driven
+    with the same round structure as LoopbackCluster."""
+
+    def __init__(self, seed_of_group, g: int = 0):
+        self.rafts = {}
+        seed = seed_of_group
+        for nid in range(1, N + 1):
+            r = Raft(
+                Config(
+                    node_id=nid,
+                    cluster_id=1,
+                    election_rtt=ELECTION,
+                    heartbeat_rtt=HEARTBEAT,
+                ),
+                InMemLogDB(),
+            )
+            for p in range(1, N + 1):
+                r.remotes[p] = Remote(next=1)
+            slot = nid - 1
+
+            def patched(r=r, slot=slot):
+                r.randomized_election_timeout = r.election_timeout + _mix(
+                    seed, r.term, slot
+                ) % r.election_timeout
+
+            r.set_randomized_election_timeout = patched
+            patched()
+            self.rafts[nid] = r
+
+    def tick_all(self):
+        for r in self.rafts.values():
+            r.tick()
+
+    def settle(self, rounds=20):
+        for _ in range(rounds):
+            msgs = []
+            for r in self.rafts.values():
+                msgs.extend(m for m in r.msgs if not is_local_message(m.type))
+                r.msgs = []
+            if not msgs:
+                return
+            for m in msgs:
+                if m.to in self.rafts:
+                    self.rafts[m.to].handle(m)
+
+    def propose(self, nid, n=1):
+        self.rafts[nid].handle(
+            Message(
+                type=MT.PROPOSE,
+                from_=nid,
+                entries=[Entry(cmd=b"p%d" % i) for i in range(n)],
+            )
+        )
+
+    def observables(self):
+        res = []
+        for nid in range(1, N + 1):
+            r = self.rafts[nid]
+            res.append(
+                {
+                    "role": int(r.state),
+                    "term": r.term,
+                    "leader": r.leader_id - 1 if r.leader_id else -1,
+                    "committed": r.log.committed,
+                    "last": r.log.last_index(),
+                }
+            )
+        return res
+
+    def log_terms(self, nid, lo, hi):
+        ents = self.rafts[nid].log.get_entries(lo, hi + 1, 1 << 30)
+        return [e.term for e in ents]
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    kc = LoopbackCluster(
+        n_replicas=N, n_groups=1, election=ELECTION, heartbeat=HEARTBEAT
+    )
+    seed = int(np.asarray(kc.states[0].seed)[0])
+    sc = ScalarCluster(seed_of_group=seed)
+    return kc, sc
+
+
+def kernel_observables(kc):
+    res = []
+    for h in range(N):
+        st = kc.states[h]
+        res.append(
+            {
+                "role": int(np.asarray(st.role)[0]),
+                "term": int(np.asarray(st.term)[0]),
+                "leader": int(np.asarray(st.leader)[0]) - 1,
+                "committed": int(np.asarray(st.committed)[0]),
+                "last": int(np.asarray(st.last_index)[0]),
+            }
+        )
+    return res
+
+
+def run_round(kc, sc, proposals=0):
+    if proposals:
+        klead = kc.leader_of(0)
+        slead = [nid for nid, r in sc.rafts.items() if r.is_leader()]
+        # both must agree on the leader before proposing
+        assert klead is not None and slead and slead[0] - 1 == klead
+        kc.propose(klead, 0, n=proposals)
+        sc.propose(slead[0], n=proposals)
+        kc.settle(10)
+        sc.settle(10)
+    kc.step(tick=True)
+    kc.settle(10)
+    sc.tick_all()
+    sc.settle(10)
+
+
+def test_differential_election_and_replication(clusters):
+    kc, sc = clusters
+    script = {12: 2, 15: 1, 20: 3, 26: 2, 33: 1}  # round -> proposals
+    for rnd in range(40):
+        run_round(kc, sc, proposals=script.get(rnd, 0))
+        ko = kernel_observables(kc)
+        so = sc.observables()
+        assert ko == so, f"round {rnd}: kernel={ko} scalar={so}"
+    # final log-term-by-index comparison over the full committed log
+    hi = so[0]["committed"]
+    assert hi >= 8
+    for h in range(N):
+        assert kc.ring_terms(h, 0, 1, hi) == sc.log_terms(h + 1, 1, hi)
+
+
+def test_differential_leader_transfer(clusters):
+    kc, sc = clusters
+    lead = kc.leader_of(0)
+    target = (lead + 1) % N
+    kc.transfer_leader(lead, 0, target)
+    sc.rafts[lead + 1].handle(
+        Message(
+            type=MT.LEADER_TRANSFER,
+            to=lead + 1,
+            from_=target + 1,
+            term=sc.rafts[lead + 1].term,
+            hint=target + 1,
+        )
+    )
+    for rnd in range(8):
+        run_round(kc, sc)
+        ko = kernel_observables(kc)
+        so = sc.observables()
+        assert ko == so, f"transfer round {rnd}: kernel={ko} scalar={so}"
+    assert kc.leader_of(0) == target

@@ -1,0 +1,287 @@
+"""Tests for the vectorized Raft kernel: protocol behavior on the loopback
+simulation cluster, plus invariant checks across randomized runs."""
+import numpy as np
+import pytest
+
+from dragonboat_tpu.ops import KernelConfig, ROLE
+from dragonboat_tpu.ops.loopback import LoopbackCluster
+
+
+def make(n=3, groups=2, **kw):
+    return LoopbackCluster(n_replicas=n, n_groups=groups, **kw)
+
+
+# ---------------------------------------------------------------- elections
+
+
+def test_kernel_single_leader_emerges():
+    c = make()
+    c.run(30)
+    for g in range(c.n_groups):
+        roles = c.roles(g)
+        assert roles.count(ROLE.LEADER) == 1, f"group {g}: {roles}"
+        terms = c.field("term", g)
+        assert len(set(terms)) == 1
+
+
+def test_kernel_all_groups_elect_independently():
+    c = make(groups=8)
+    c.run(40)
+    for g in range(8):
+        assert c.leader_of(g) is not None
+
+
+def test_kernel_leader_stable_after_election():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    term = c.field("term", 0)[lead]
+    c.run(30)
+    assert c.leader_of(0) == lead
+    assert c.field("term", 0)[lead] == term  # no spurious re-elections
+
+
+def test_kernel_reelection_after_leader_isolated():
+    c = make()
+    c.run(30)
+    old = c.leader_of(0)
+    c.isolated.add(old)
+    c.run(35)
+    survivors = [h for h in range(3) if h != old]
+    new_leaders = [h for h in survivors if c.roles(0)[h] == ROLE.LEADER]
+    assert len(new_leaders) == 1
+    # heal: old leader rejoins and steps down
+    c.isolated.clear()
+    c.run(10)
+    assert c.roles(0).count(ROLE.LEADER) == 1
+    assert c.roles(0)[old] != ROLE.LEADER
+
+
+# ---------------------------------------------------------------- replication
+
+
+def test_kernel_propose_commits_everywhere():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    c.propose(lead, 0, n=3)
+    c.run(3)
+    commits = c.field("committed", 0)
+    lasts = c.field("last_index", 0)
+    assert len(set(commits)) == 1
+    assert commits[0] == lasts[0] == 4  # noop + 3 proposals
+    # log terms identical across replicas
+    t0 = c.ring_terms(0, 0, 1, 4)
+    assert t0 == c.ring_terms(1, 0, 1, 4) == c.ring_terms(2, 0, 1, 4)
+
+
+def test_kernel_save_ranges_reported():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    c.propose(lead, 0, n=2)
+    c.step(tick=False)
+    out = c.last_outputs[lead]
+    sf, st_ = int(np.asarray(out.save_from)[0]), int(np.asarray(out.save_to)[0])
+    assert sf > 0 and st_ >= sf  # the two new entries must be persisted
+
+
+def test_kernel_commit_requires_quorum():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    others = [h for h in range(3) if h != lead]
+    c.isolated.update(others)  # leader alone: no quorum
+    before = c.field("committed", 0)[lead]
+    c.propose(lead, 0, n=1)
+    for _ in range(5):
+        c.step(tick=False)
+    assert c.field("committed", 0)[lead] == before
+    c.isolated.clear()
+    c.run(3)
+    assert c.field("committed", 0)[lead] == before + 1
+
+
+def test_kernel_divergent_follower_converges():
+    """A replica that accepted uncommitted entries from a deposed leader must
+    overwrite them with the new leader's log (paper 5.3)."""
+    c = make()
+    c.run(30)
+    old = c.leader_of(0)
+    # strand proposals on the old leader only
+    c.isolated.update(h for h in range(3) if h != old)
+    c.propose(old, 0, n=3)
+    for _ in range(3):
+        c.step(tick=False)
+    assert c.field("last_index", 0)[old] > c.field("committed", 0)[old]
+    # partition flips: old leader cut off, others elect
+    c.isolated.clear()
+    c.isolated.add(old)
+    c.run(35)
+    new = [h for h in range(3) if h != old and c.roles(0)[h] == ROLE.LEADER][0]
+    c.propose(new, 0, n=2)
+    c.run(3)
+    # heal; old leader must converge to the new log
+    c.isolated.clear()
+    c.run(12)
+    lasts = c.field("last_index", 0)
+    commits = c.field("committed", 0)
+    assert len(set(commits)) == 1
+    hi = commits[0]
+    ref = c.ring_terms(new, 0, 1, hi)
+    assert c.ring_terms(old, 0, 1, hi) == ref
+
+
+def test_kernel_follower_catchup_from_empty():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    straggler = [h for h in range(3) if h != lead][0]
+    c.isolated.add(straggler)
+    for _ in range(4):
+        c.propose(lead, 0, n=2)
+        c.run(2)
+    c.isolated.clear()
+    c.run(12)
+    assert c.field("last_index", 0)[straggler] == c.field("last_index", 0)[lead]
+    assert c.field("committed", 0)[straggler] == c.field("committed", 0)[lead]
+
+
+# ---------------------------------------------------------------- readindex
+
+
+def test_kernel_readindex_quorum_roundtrip():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    c.read_index(lead, 0, ctx=4242)
+    c.run(3)
+    hits = [r for r in c.ready_reads[lead] if r[0] == 0 and r[1] == 4242]
+    assert hits, f"no ready read: {c.ready_reads[lead]}"
+    assert hits[0][2] == c.field("committed", 0)[lead]
+
+
+def test_kernel_readindex_multiple_ctxs_fifo():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    c.read_index(lead, 0, ctx=11)
+    c.read_index(lead, 0, ctx=12)
+    c.run(4)
+    ctxs = [r[1] for r in c.ready_reads[lead] if r[0] == 0]
+    assert ctxs[:2] == [11, 12]
+
+
+# ---------------------------------------------------------------- transfer
+
+
+def test_kernel_leader_transfer():
+    c = make()
+    c.run(30)
+    lead = c.leader_of(0)
+    target = [h for h in range(3) if h != lead][0]
+    c.transfer_leader(lead, 0, target)
+    c.run(8)
+    assert c.leader_of(0) == target
+    assert c.roles(0)[lead] != ROLE.LEADER
+
+
+# ---------------------------------------------------------------- witnesses
+
+
+def test_kernel_witness_in_quorum():
+    """2 full replicas + 1 witness: witness vote/ack counts toward quorum."""
+    c = make(n=3, witnesses=(2,))
+    c.run(40)
+    lead = c.leader_of(0)
+    assert lead in (0, 1)
+    assert c.roles(0)[2] == ROLE.WITNESS
+    # kill the other full replica: leader + witness still form a quorum
+    other = 1 - lead
+    c.isolated.add(other)
+    before = c.field("committed", 0)[lead]
+    c.propose(lead, 0, n=1)
+    c.run(4)
+    assert c.field("committed", 0)[lead] == before + 1
+
+
+def test_kernel_observer_replicates_without_voting():
+    c = make(n=3, observers=(2,))
+    c.run(40)
+    lead = c.leader_of(0)
+    assert lead in (0, 1)
+    assert c.roles(0)[2] == ROLE.OBSERVER
+    c.propose(lead, 0, n=2)
+    c.run(4)
+    # observer received the data
+    assert c.field("last_index", 0)[2] == c.field("last_index", 0)[lead]
+    # but quorum is the 2 voting members: isolating the other full member
+    # blocks commit even though the observer acks
+    other = 1 - lead
+    c.isolated.add(other)
+    before = c.field("committed", 0)[lead]
+    c.propose(lead, 0, n=1)
+    c.run(4)
+    assert c.field("committed", 0)[lead] == before
+
+
+# ---------------------------------------------------------------- check quorum
+
+
+def test_kernel_check_quorum_step_down():
+    c = make(check_quorum=True)
+    c.run(30)
+    lead = c.leader_of(0)
+    c.isolated.update(h for h in range(3) if h != lead)
+    # two election periods without responses => step down
+    for _ in range(25):
+        c.step(tick=True)
+    assert c.roles(0)[lead] != ROLE.LEADER
+
+
+# ---------------------------------------------------------------- randomized
+
+
+def test_kernel_randomized_chaos_invariants():
+    """Random drops/partitions/proposals; at all times: at most one leader
+    per term, committed prefixes never diverge, commit never regresses."""
+    rng = np.random.default_rng(3)
+    c = make(groups=2)
+    c.run(30)
+    max_commit = {g: 0 for g in range(2)}
+    for it in range(60):
+        # random link chaos
+        c.dropped_links.clear()
+        for _ in range(rng.integers(0, 3)):
+            a, b = rng.integers(0, 3, 2)
+            if a != b:
+                c.dropped_links.add((int(a), int(b)))
+        for g in range(2):
+            lead = c.leader_of(g)
+            if lead is not None and rng.random() < 0.7:
+                c.propose(lead, g, n=int(rng.integers(1, 4)))
+        c.step(tick=True)
+        if rng.random() < 0.5:
+            c.settle(5)
+        for g in range(2):
+            commits = c.field("committed", g)
+            terms = c.field("term", g)
+            # at most one leader per term
+            lt = [
+                (terms[h], h)
+                for h in range(3)
+                if c.roles(g)[h] == ROLE.LEADER
+            ]
+            assert len({t for t, _ in lt}) == len(lt), f"two leaders one term: {lt}"
+            # committed prefix equality on the common committed prefix
+            m = min(commits)
+            if m >= 1:
+                r0 = c.ring_terms(0, g, 1, m)
+                assert r0 == c.ring_terms(1, g, 1, m) == c.ring_terms(2, g, 1, m)
+            assert max(commits) >= max_commit[g]
+            max_commit[g] = max(commits)
+    # heal and converge
+    c.dropped_links.clear()
+    c.run(20)
+    for g in range(2):
+        assert len(set(c.field("committed", g))) == 1
