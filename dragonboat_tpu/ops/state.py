@@ -206,51 +206,53 @@ class StepOutput(NamedTuple):
 def init_state(cfg: KernelConfig) -> RaftTensors:
     G, P, W, R = cfg.groups, cfg.peers, cfg.log_window, cfg.readindex_depth
     i32 = jnp.int32
-    z_g = jnp.zeros((G,), i32)
-    z_gp = jnp.zeros((G, P), i32)
-    f_g = jnp.zeros((G,), bool)
-    f_gp = jnp.zeros((G, P), bool)
+    # each field gets its own buffer: aliased buffers break jit donation
+    # (the engine donates the state pytree every step)
+    z_g = lambda: jnp.zeros((G,), i32)
+    z_gp = lambda: jnp.zeros((G, P), i32)
+    f_g = lambda: jnp.zeros((G,), bool)
+    f_gp = lambda: jnp.zeros((G, P), bool)
     return RaftTensors(
-        active=f_g,
-        self_slot=z_g,
-        member=f_gp,
-        voting=f_gp,
-        observer=f_gp,
-        witness=f_gp,
-        term=z_g,
-        vote=z_g,
-        role=z_g,
-        leader=z_g,
-        tick_count=z_g,
-        election_tick=z_g,
-        heartbeat_tick=z_g,
+        active=f_g(),
+        self_slot=z_g(),
+        member=f_gp(),
+        voting=f_gp(),
+        observer=f_gp(),
+        witness=f_gp(),
+        term=z_g(),
+        vote=z_g(),
+        role=z_g(),
+        leader=z_g(),
+        tick_count=z_g(),
+        election_tick=z_g(),
+        heartbeat_tick=z_g(),
         rand_timeout=jnp.full((G,), 10, i32),
         election_timeout=jnp.full((G,), 10, i32),
         heartbeat_timeout=jnp.full((G,), 1, i32),
-        check_quorum=f_g,
+        check_quorum=f_g(),
         first_index=jnp.ones((G,), i32),
-        marker_term=z_g,
-        last_index=z_g,
-        committed=z_g,
-        processed=z_g,
-        applied=z_g,
+        marker_term=z_g(),
+        last_index=z_g(),
+        committed=z_g(),
+        processed=z_g(),
+        applied=z_g(),
         unsaved_from=jnp.ones((G,), i32),
         log_term=jnp.zeros((G, W), i32),
         log_is_cc=jnp.zeros((G, W), bool),
-        match=z_gp,
+        match=z_gp(),
         next=jnp.ones((G, P), i32),
-        rstate=z_gp,
-        ract=f_gp,
-        snap_sent=z_gp,
-        vresp=f_gp,
-        vgrant=f_gp,
-        transfer_to=z_g,
-        transfer_flag=f_g,
-        pending_cc=f_g,
+        rstate=z_gp(),
+        ract=f_gp(),
+        snap_sent=z_gp(),
+        vresp=f_gp(),
+        vgrant=f_gp(),
+        transfer_to=z_g(),
+        transfer_flag=f_g(),
+        pending_cc=f_g(),
         ri_ctx=jnp.zeros((G, R), i32),
         ri_index=jnp.zeros((G, R), i32),
         ri_acks=jnp.zeros((G, R), i32),
-        ri_count=z_g,
+        ri_count=z_g(),
         seed=jnp.arange(1, G + 1, dtype=jnp.uint32) * jnp.uint32(2654435761),
     )
 
