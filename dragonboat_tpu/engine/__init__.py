@@ -1,0 +1,8 @@
+"""Execution engine layer (cf. execengine.go, node.go)."""
+
+from .execengine import ExecEngine, WorkReady
+from .node import Node
+from .quiesce import QuiesceManager
+from .snapshotter import Snapshotter
+
+__all__ = ["ExecEngine", "WorkReady", "Node", "QuiesceManager", "Snapshotter"]

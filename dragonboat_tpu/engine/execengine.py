@@ -1,0 +1,237 @@
+"""Execution engine: fixed worker pools advancing many Raft groups.
+
+cf. execengine.go:126-644 — the scheduler at the heart of multi-group
+parallelism. Step workers run the protocol hot loop, task workers apply
+committed entries to state machines, snapshot workers run save/recover/
+stream. Groups are statically partitioned to workers by
+cluster_id % worker_count (cf. internal/server/partition.go:22-41).
+
+The hot loop preserves the reference's ordering invariants
+(execengine.go:474-560):
+  step -> fast-apply -> send Replicate (BEFORE fsync) -> SaveRaftState
+  (fsync) -> stable-apply -> process update (append window, send rest)
+  -> commit cursors
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..settings import hard, soft
+from ..types import Update
+from .node import Node
+
+
+class WorkReady:
+    """Partitioned ready-channels (cf. execengine.go:82-124): producers mark
+    a cluster ready; the owning worker drains its partition's set."""
+
+    def __init__(self, partitions: int) -> None:
+        self._n = partitions
+        self._sets: List[Set[int]] = [set() for _ in range(partitions)]
+        self._events = [threading.Event() for _ in range(partitions)]
+        self._locks = [threading.Lock() for _ in range(partitions)]
+
+    def partition(self, cluster_id: int) -> int:
+        return cluster_id % self._n
+
+    def notify(self, cluster_id: int) -> None:
+        p = self.partition(cluster_id)
+        with self._locks[p]:
+            self._sets[p].add(cluster_id)
+        self._events[p].set()
+
+    def notify_all(self, cluster_ids) -> None:
+        touched = set()
+        for cid in cluster_ids:
+            p = self.partition(cid)
+            with self._locks[p]:
+                self._sets[p].add(cid)
+            touched.add(p)
+        for p in touched:
+            self._events[p].set()
+
+    def wait_and_take(self, worker: int, timeout: float = 0.5) -> Set[int]:
+        ev = self._events[worker]
+        if not ev.wait(timeout):
+            return set()
+        with self._locks[worker]:
+            out = self._sets[worker]
+            self._sets[worker] = set()
+            ev.clear()
+        return out
+
+    def wake_all(self) -> None:
+        for ev in self._events:
+            ev.set()
+
+
+class ExecEngine:
+    def __init__(
+        self,
+        logdb,
+        num_step_workers: Optional[int] = None,
+        num_task_workers: Optional[int] = None,
+        num_snapshot_workers: int = 4,
+    ) -> None:
+        self._logdb = logdb
+        # Python threads contend on the GIL: default pools are smaller than
+        # the Go engine's 16; protocol work is lock-striped the same way
+        self._n_step = num_step_workers or min(hard.step_engine_worker_count, 8)
+        self._n_task = num_task_workers or min(
+            soft.step_engine_task_worker_count, 8
+        )
+        self._n_snap = num_snapshot_workers
+        self._nodes: Dict[int, Node] = {}
+        self._nodes_mu = threading.RLock()
+        self._stopped = threading.Event()
+        self.node_ready = WorkReady(self._n_step)
+        self.task_ready = WorkReady(self._n_task)
+        self.snapshot_ready = WorkReady(self._n_snap)
+        self._threads: List[threading.Thread] = []
+        for i in range(self._n_step):
+            t = threading.Thread(
+                target=self._node_worker_main, args=(i,), name=f"step-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(self._n_task):
+            t = threading.Thread(
+                target=self._task_worker_main, args=(i,), name=f"task-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(self._n_snap):
+            t = threading.Thread(
+                target=self._snapshot_worker_main,
+                args=(i,),
+                name=f"snap-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------- registry
+    def add_node(self, node: Node) -> None:
+        with self._nodes_mu:
+            self._nodes[node.cluster_id] = node
+        self.set_node_ready(node.cluster_id)
+
+    def remove_node(self, cluster_id: int) -> None:
+        with self._nodes_mu:
+            self._nodes.pop(cluster_id, None)
+
+    def get_node(self, cluster_id: int) -> Optional[Node]:
+        with self._nodes_mu:
+            return self._nodes.get(cluster_id)
+
+    # -------------------------------------------------------------- wakeups
+    def set_node_ready(self, cluster_id: int) -> None:
+        self.node_ready.notify(cluster_id)
+
+    def set_task_ready(self, cluster_id: int) -> None:
+        self.task_ready.notify(cluster_id)
+
+    def set_snapshot_ready(self, cluster_id: int) -> None:
+        self.snapshot_ready.notify(cluster_id)
+
+    # ---------------------------------------------------------- step workers
+    def _node_worker_main(self, worker: int) -> None:
+        while not self._stopped.is_set():
+            cids = self.node_ready.wait_and_take(worker)
+            if not cids:
+                continue
+            nodes = []
+            with self._nodes_mu:
+                for cid in cids:
+                    n = self._nodes.get(cid)
+                    if n is not None and not n.stopped:
+                        nodes.append(n)
+            if nodes:
+                try:
+                    self.exec_nodes(nodes)
+                except Exception:  # a group failure must not kill the worker
+                    import traceback
+
+                    traceback.print_exc()
+
+    def exec_nodes(self, nodes: List[Node]) -> None:
+        """THE hot loop (cf. execNodes execengine.go:474-560)."""
+        updates: List[Tuple[Node, Update]] = []
+        for node in nodes:
+            if not node.initialized.is_set():
+                node.recover_initial_snapshot()
+            ud = node.step_node()
+            if ud is not None:
+                node.process_dropped(ud)
+                updates.append((node, ud))
+        if not updates:
+            return
+        # 1. fast-apply: committed entries reach the SM before the fsync when
+        #    safe (peer.set_fast_apply decided per update)
+        for node, ud in updates:
+            if ud.fast_apply:
+                node.apply_raft_update(ud)
+        # 2. Replicate messages leave before the local fsync
+        for node, ud in updates:
+            node.send_replicate_messages(ud)
+        # 3. one batched fsynced write for every group this worker stepped
+        self._logdb.save_raft_state([ud for _, ud in updates])
+        # 4. stable apply for the rest
+        for node, ud in updates:
+            if not ud.fast_apply:
+                node.apply_raft_update(ud)
+        # 5. window append, remaining sends, snapshot triggers, cursors
+        for node, ud in updates:
+            node.process_raft_update(ud)
+            node.commit_raft_update(ud)
+
+    # ---------------------------------------------------------- task workers
+    def _task_worker_main(self, worker: int) -> None:
+        batch: list = []
+        apply: list = []
+        while not self._stopped.is_set():
+            cids = self.task_ready.wait_and_take(worker)
+            if not cids:
+                continue
+            for cid in cids:
+                node = self.get_node(cid)
+                if node is None or node.stopped:
+                    continue
+                try:
+                    node.handle_task(batch, apply)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                if node.sm.task_queue.size() > 0:
+                    self.set_task_ready(cid)
+
+    # ------------------------------------------------------ snapshot workers
+    def _snapshot_worker_main(self, worker: int) -> None:
+        while not self._stopped.is_set():
+            cids = self.snapshot_ready.wait_and_take(worker)
+            if not cids:
+                continue
+            for cid in cids:
+                node = self.get_node(cid)
+                if node is None or node.stopped:
+                    continue
+                try:
+                    node.run_snapshot_work()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    # --------------------------------------------------------------- control
+    def stop(self) -> None:
+        self._stopped.set()
+        self.node_ready.wake_all()
+        self.task_ready.wake_all()
+        self.snapshot_ready.wake_all()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+__all__ = ["ExecEngine", "WorkReady"]

@@ -1,0 +1,548 @@
+"""Per-group node runtime: binds one Raft group's Peer + state machine +
+request queues and pumps events between them.
+
+cf. node.go:53-1399 — the node is the unit the execution engine schedules.
+All protocol work happens inside step_node() on a step worker; all apply
+work inside handle_task() on a task worker; the public request methods only
+enqueue and wake the engine.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, List, Optional
+
+from ..client import Session
+from ..config import Config
+from ..core.peer import Peer, PeerAddress, encode_config_change
+from ..core.logentry import ErrCompacted
+from ..requests import (
+    ErrClusterClosed,
+    ErrPayloadTooBig,
+    ErrSystemBusy,
+    LogicalClock,
+    PendingConfigChange,
+    PendingLeaderTransfer,
+    PendingProposal,
+    PendingReadIndex,
+    PendingSnapshot,
+    RequestState,
+)
+from ..rsm import (
+    SSRequest,
+    SS_REQ_EXPORTED,
+    SS_REQ_USER,
+    StateMachineManager,
+    Task,
+    wrap_state_machine,
+)
+from ..settings import soft
+from ..statemachine import Result
+from ..types import (
+    ConfigChange,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    Update,
+)
+from .quiesce import QuiesceManager
+from .queue import EntryQueue, MessageQueue, ReadIndexQueue
+
+
+class Node:
+    def __init__(
+        self,
+        cfg: Config,
+        peer_addresses: List[PeerAddress],
+        initial: bool,
+        new_node: bool,
+        sm_factory: Callable,
+        log_reader,
+        logdb,
+        snapshotter,
+        send_message: Callable[[Message], None],
+        engine,
+        event_listener=None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.config = cfg
+        self.cluster_id = cfg.cluster_id
+        self._node_id = cfg.node_id
+        self.log_reader = log_reader
+        self.logdb = logdb
+        self.snapshotter = snapshotter
+        self._send_message = send_message
+        self.engine = engine
+        self.events = event_listener
+        self.clock = LogicalClock()
+        self.pending_proposals = PendingProposal(self.clock)
+        self.pending_read_indexes = PendingReadIndex(self.clock)
+        self.pending_config_change = PendingConfigChange(self.clock)
+        self.pending_snapshot = PendingSnapshot(self.clock)
+        self.pending_leader_transfer = PendingLeaderTransfer()
+        self.incoming_proposals = EntryQueue(soft.incoming_proposal_queue_length)
+        self.incoming_reads = ReadIndexQueue(soft.incoming_read_index_queue_length)
+        self.mq = MessageQueue(soft.received_message_queue_length)
+        self.quiesce_mgr = QuiesceManager(
+            enabled=cfg.quiesce, election_tick=cfg.election_rtt
+        )
+        self.stopped = False
+        self._mu = threading.Lock()
+        self._init_mu = threading.Lock()
+        # config-change requests handed from API to step worker
+        self._cc_queue: List = []
+        self._leader_id = 0
+        self._current_term = 0
+        self.initialized = threading.Event()
+        # rsm manager
+        managed = wrap_state_machine(
+            sm_factory(cfg.cluster_id, cfg.node_id), cfg.cluster_id, cfg.node_id
+        )
+        self.sm = StateMachineManager(snapshotter, managed, self, cfg)
+        if snapshotter is not None:
+            snapshotter.bind_sm(self.sm)
+        # snapshot bookkeeping
+        self._applied_since_snapshot = 0
+        self._snapshot_lock = threading.Lock()
+        self._snapshot_in_progress = False
+        self._stream_requests: List = []
+        # launch the protocol core
+        self.peer = Peer.launch(
+            cfg,
+            log_reader,
+            events=self._make_raft_event_adapter(),
+            addresses=peer_addresses,
+            initial=initial,
+            new_node=new_node,
+            rng=rng,
+        )
+        if not self._has_snapshot_to_recover():
+            self.initialized.set()
+
+    # ----------------------------------------------------------------- naming
+    def node_id(self) -> int:
+        return self._node_id
+
+    def describe(self) -> str:
+        return f"[{self.cluster_id:05d}:{self._node_id:05d}]"
+
+    # ----------------------------------------------------- INodeProxy methods
+    def node_ready(self) -> None:
+        self.engine.set_node_ready(self.cluster_id)
+
+    def apply_update(self, entry, result, rejected, ignored, notify_read) -> None:
+        self.pending_proposals.applied(
+            entry.key, entry.client_id, entry.series_id, result, rejected
+        )
+        if notify_read:
+            self.pending_read_indexes.applied(entry.index)
+
+    def apply_config_change(self, cc: ConfigChange) -> None:
+        """Called by the RSM when a config change commits; updates the
+        protocol-core membership (cf. node.go applyConfigChange)."""
+        with self._mu:
+            self.peer.apply_config_change(cc)
+        if cc.node_id == self._node_id and cc.type.name == "REMOVE_NODE":
+            pass  # node removal handled by nodehost monitor
+
+    def config_change_processed(self, key: int, accepted: bool) -> None:
+        if accepted:
+            self.pending_config_change.apply(key, rejected=False)
+        else:
+            self.peer.reject_config_change()
+            self.pending_config_change.apply(key, rejected=True)
+
+    def should_stop(self) -> bool:
+        return self.stopped
+
+    # ------------------------------------------------------------ public API
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> RequestState:
+        if len(cmd) > soft.max_proposal_payload_size:
+            raise ErrPayloadTooBig()
+        rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        if not self.incoming_proposals.add(entry):
+            self.pending_proposals.dropped(rs.key)
+            raise ErrSystemBusy()
+        self.engine.set_node_ready(self.cluster_id)
+        return rs
+
+    def read(self, timeout_ticks: int) -> RequestState:
+        rs = self.pending_read_indexes.read(timeout_ticks)
+        if not self.incoming_reads.add(rs):
+            raise ErrSystemBusy()
+        self.engine.set_node_ready(self.cluster_id)
+        return rs
+
+    def request_config_change(
+        self, cc: ConfigChange, timeout_ticks: int
+    ) -> RequestState:
+        rs, cc, key = self.pending_config_change.request(cc, timeout_ticks)
+        with self._mu:
+            self._cc_queue.append((cc, key))
+        self.engine.set_node_ready(self.cluster_id)
+        return rs
+
+    def request_snapshot(self, req: SSRequest, timeout_ticks: int) -> RequestState:
+        rs, req = self.pending_snapshot.request(req, timeout_ticks)
+        self.push_take_snapshot_request(req)
+        return rs
+
+    def request_leader_transfer(self, target_id: int) -> None:
+        self.pending_leader_transfer.request(target_id)
+        self.engine.set_node_ready(self.cluster_id)
+
+    # -------------------------------------------------------- engine: stepping
+    def step_node(self) -> Optional[Update]:
+        """One protocol step (cf. node.go:1016-1067 stepNode/handleEvents).
+        Runs on a step worker; returns an Update to process or None."""
+        if self.stopped:
+            return None
+        with self._mu:
+            last_applied = self.sm.last_applied_index()
+            # applied cursor feeds campaign eligibility + entry pagination
+            # (cf. node.go stepNode -> p.NotifyRaftLastApplied)
+            self.peer.notify_raft_last_applied(last_applied)
+            has_event = self._handle_events()
+            if not has_event:
+                return None
+            if not self.peer.has_update(True):
+                # still commit the logical clock work
+                return None
+            ud = self.peer.get_update(True, last_applied)
+            return ud
+
+    def _handle_events(self) -> bool:
+        had = False
+        had |= self._handle_read_index_requests()
+        had |= self._handle_received_messages()
+        had |= self._handle_config_change_requests()
+        had |= self._handle_proposals()
+        had |= self._handle_leader_transfer()
+        # always step if the peer accumulated output (e.g. from ticks)
+        return had or self.peer.has_update(True) or self.peer.has_entry_to_apply()
+
+    def _handle_proposals(self) -> bool:
+        entries = self.incoming_proposals.get()
+        if not entries:
+            return False
+        self.quiesce_mgr.record_activity()
+        self.peer.propose_entries(entries)
+        return True
+
+    def _handle_read_index_requests(self) -> bool:
+        reqs = self.incoming_reads.get()
+        if not reqs:
+            return False
+        self.quiesce_mgr.record_activity()
+        ctx = self.pending_read_indexes.next_ctx()
+        if self.pending_read_indexes.bind_queued_states(reqs, ctx):
+            self.peer.read_index(ctx)
+        return True
+
+    def _handle_config_change_requests(self) -> bool:
+        if not self._cc_queue:
+            return False
+        ccs, self._cc_queue = self._cc_queue, []
+        for cc, key in ccs:
+            self.quiesce_mgr.record_activity()
+            self.peer.propose_config_change(cc, key)
+        return True
+
+    def _handle_leader_transfer(self) -> bool:
+        target = self.pending_leader_transfer.get()
+        if target is None:
+            return False
+        self.peer.request_leader_transfer(target)
+        return True
+
+    def _handle_received_messages(self) -> bool:
+        msgs, ticks = self.mq.get()
+        if ticks > 0:
+            # coalesced ticks capped at election timeout (node.go:1152-1159)
+            for _ in range(min(ticks, self.config.election_rtt)):
+                self._tick()
+        had = ticks > 0
+        for m in msgs:
+            had = True
+            if m.type == MessageType.INSTALL_SNAPSHOT:
+                self._handle_install_snapshot(m)
+            elif m.type == MessageType.REPLICATE and self._snapshot_busy():
+                continue  # drop Replicate while snapshotting (node.go:1199)
+            elif m.type == MessageType.QUIESCE:
+                self.quiesce_mgr.try_enter_quiesce()
+            else:
+                if not m.type == MessageType.LOCAL_TICK:
+                    self.quiesce_mgr.record_activity()
+                self.peer.handle(m)
+        return had
+
+    def _handle_install_snapshot(self, m: Message) -> None:
+        self.quiesce_mgr.record_activity()
+        self.peer.handle(m)
+
+    def _tick(self) -> None:
+        self.clock.increase_tick()
+        self.pending_proposals.gc()
+        self.pending_read_indexes.gc()
+        self.pending_config_change.gc()
+        self.pending_snapshot.gc()
+        if self.quiesce_mgr.tick():
+            self.peer.quiesced_tick()
+        else:
+            self.peer.tick()
+
+    # ----------------------------------------------- engine: update processing
+    def process_dropped(self, ud: Update) -> None:
+        for e in ud.dropped_entries:
+            self.pending_proposals.dropped(e.key)
+        for ctx in ud.dropped_read_indexes:
+            self.pending_read_indexes.dropped(ctx)
+
+    def send_replicate_messages(self, ud: Update) -> None:
+        """Replicate messages leave before the local fsync — Raft thesis
+        §10.2.1 pipelining (cf. execengine.go:508-516)."""
+        for m in ud.messages:
+            if m.type == MessageType.REPLICATE:
+                m.cluster_id = self.cluster_id
+                self._send_message(m)
+
+    def process_raft_update(self, ud: Update) -> None:
+        """Post-fsync processing (cf. node.go:975-1000)."""
+        if ud.snapshot is not None and not ud.snapshot.is_empty():
+            self.log_reader.apply_snapshot(ud.snapshot)
+        self.log_reader.append(ud.entries_to_save)
+        for m in ud.messages:
+            if m.type == MessageType.REPLICATE:
+                continue
+            m.cluster_id = self.cluster_id
+            self._send_message(m)
+        if ud.state is not None and not ud.state.is_empty():
+            self.log_reader.set_state(ud.state)
+        if ud.ready_to_reads:
+            # confirmed read contexts release once the SM catches up
+            # (cf. node.go:943-948 processReadyToRead)
+            self.pending_read_indexes.add_ready_to_read(ud.ready_to_reads)
+        self.pending_read_indexes.applied(self.sm.last_applied_index())
+        self._save_snapshot_required(ud)
+
+    def apply_raft_update(self, ud: Update) -> None:
+        """Queue committed entries for the task workers
+        (cf. node.go:967-973 + pushEntries node.go:505-515)."""
+        if ud.snapshot is not None and not ud.snapshot.is_empty():
+            self._push_install_snapshot(ud.snapshot)
+        if not ud.committed_entries:
+            return
+        self.sm.task_queue.add(
+            Task(
+                cluster_id=self.cluster_id,
+                node_id=self._node_id,
+                entries=ud.committed_entries,
+            )
+        )
+        self._applied_since_snapshot += len(ud.committed_entries)
+        self.engine.set_task_ready(self.cluster_id)
+
+    def commit_raft_update(self, ud: Update) -> None:
+        with self._mu:
+            self.peer.commit(ud)
+
+    # ------------------------------------------------------- engine: applying
+    def handle_task(self, batch, apply) -> bool:
+        """Drain apply work on a task worker; returns True if a snapshot
+        task needs a snapshot worker (cf. node.go:795)."""
+        st = self.sm.handle(batch, apply)
+        if st is not None:
+            self._pending_snapshot_task = st
+            self.engine.set_snapshot_ready(self.cluster_id)
+            return True
+        return False
+
+    # ------------------------------------------------------- snapshot drivers
+    def _has_snapshot_to_recover(self) -> bool:
+        if self.snapshotter is None:
+            return False
+        ss = self.snapshotter.get_most_recent_snapshot()
+        return ss is not None and not ss.is_empty()
+
+    def recover_initial_snapshot(self) -> None:
+        """Engine init path: install the newest snapshot before stepping
+        (cf. getUninitializedNodeTask node.go:1318-1328). Idempotent under
+        racing callers (start_cluster thread + step worker)."""
+        with self._init_mu:
+            if self.initialized.is_set():
+                return
+            self._recover_initial_snapshot_locked()
+            self.initialized.set()
+
+    def _recover_initial_snapshot_locked(self) -> None:
+        t = Task(
+            cluster_id=self.cluster_id,
+            node_id=self._node_id,
+            snapshot_available=True,
+        )
+        idx = self.sm.recover_from_snapshot(t)
+        if idx > 0:
+            self.peer.notify_raft_last_applied(self.sm.last_applied_index())
+
+    def _push_install_snapshot(self, ss: Snapshot) -> None:
+        """A snapshot arrived through the protocol (InstallSnapshot path):
+        recover the SM from it (cf. node.go:950-965 processSnapshot)."""
+        t = Task(
+            cluster_id=self.cluster_id,
+            node_id=self._node_id,
+            index=ss.index,
+            snapshot_available=True,
+            init_done=True,
+        )
+        self.sm.task_queue.add(t)
+        self.engine.set_task_ready(self.cluster_id)
+
+    def push_take_snapshot_request(self, req: SSRequest) -> None:
+        t = Task(
+            cluster_id=self.cluster_id,
+            node_id=self._node_id,
+            snapshot_requested=True,
+            ss_request=req,
+        )
+        self.sm.task_queue.add(t)
+        self.engine.set_task_ready(self.cluster_id)
+
+    def _push_stream_snapshot_request(self, m: Message) -> None:
+        """Leader streams a snapshot to a lagging on-disk follower; regular
+        SMs send the latest snapshot file chunked (cf. nodehost.go:1724-1744)."""
+        with self._snapshot_lock:
+            self._stream_requests.append(m)
+        self.engine.set_snapshot_ready(self.cluster_id)
+
+    def _snapshot_busy(self) -> bool:
+        with self._snapshot_lock:
+            return self._snapshot_in_progress
+
+    def _save_snapshot_required(self, ud: Update) -> None:
+        """Periodic snapshot trigger by applied-entry count
+        (cf. node.go:585-601 saveSnapshotRequired)."""
+        se = self.config.snapshot_entries
+        if se == 0 or self.snapshotter is None:
+            return
+        if self._applied_since_snapshot < se:
+            return
+        with self._snapshot_lock:
+            if self._snapshot_in_progress:
+                return
+            self._snapshot_in_progress = True
+        self._applied_since_snapshot = 0
+        self.push_take_snapshot_request(SSRequest())
+
+    def run_snapshot_work(self) -> None:
+        """Executed on a snapshot worker: take/recover/stream snapshots
+        (cf. execengine.go:227-335 snapshot worker mains)."""
+        task = getattr(self, "_pending_snapshot_task", None)
+        self._pending_snapshot_task = None
+        if task is not None:
+            if task.snapshot_requested:
+                self._do_save_snapshot(task.ss_request or SSRequest())
+            elif task.snapshot_available:
+                self._do_recover_snapshot(task)
+        with self._snapshot_lock:
+            streams, self._stream_requests = self._stream_requests, []
+        for m in streams:
+            self._do_stream_snapshot(m)
+
+    def _do_save_snapshot(self, req: SSRequest) -> None:
+        try:
+            if self.snapshotter is None:
+                self.pending_snapshot.apply(0, ignored=True)
+                return
+            ss, env = self.sm.save_snapshot(req)
+            self.snapshotter.commit(ss, req)
+            self.log_reader.create_snapshot(ss)
+            self._compact_log(ss, req)
+            self.pending_snapshot.apply(ss.index, ignored=False)
+        except Exception:
+            self.pending_snapshot.apply(0, ignored=False, failed=True)
+        finally:
+            with self._snapshot_lock:
+                self._snapshot_in_progress = False
+
+    def _do_recover_snapshot(self, task: Task) -> None:
+        idx = self.sm.recover_from_snapshot(task)
+        if idx > 0:
+            ss = self.snapshotter.get_most_recent_snapshot()
+            if ss is not None and not ss.is_empty():
+                with self._mu:
+                    self.log_reader.apply_snapshot(ss)
+                    self.peer.restore_remotes(ss)
+                    self.peer.notify_raft_last_applied(self.sm.last_applied_index())
+
+    def _do_stream_snapshot(self, m: Message) -> None:
+        if self.snapshotter is None:
+            return
+        self.snapshotter.stream_to(self, m)
+
+    def _compact_log(self, ss: Snapshot, req: SSRequest) -> None:
+        """Keep compaction_overhead entries behind the snapshot
+        (cf. node.go:680-693 + 849-867)."""
+        overhead = (
+            req.compaction_overhead
+            if req is not None and req.override_compaction
+            else self.config.compaction_overhead
+        )
+        if overhead == 0:
+            return
+        if ss.index <= overhead:
+            return
+        compact_to = ss.index - overhead
+        try:
+            with self._mu:
+                self.log_reader.compact(compact_to)
+        except ErrCompacted:
+            return  # already compacted past this point: benign
+        self.logdb.remove_entries_to(self.cluster_id, self._node_id, compact_to)
+
+    # ---------------------------------------------------------------- events
+    def _make_raft_event_adapter(self):
+        node = self
+
+        class _Adapter:
+            def leader_updated(self, cluster_id, node_id, leader_id, term):
+                node._leader_id = leader_id
+                node._current_term = term
+                if node.events is not None:
+                    node.events.leader_updated(cluster_id, node_id, leader_id, term)
+
+            def __getattr__(self, name):
+                def noop(*a, **k):
+                    return None
+
+                return noop
+
+        return _Adapter()
+
+    def get_leader_id(self):
+        with self._mu:
+            st = self.peer.local_status()
+        return st["leader_id"]
+
+    def local_status(self):
+        with self._mu:
+            return self.peer.local_status()
+
+    # -------------------------------------------------------------- shutdown
+    def close(self) -> None:
+        self.stopped = True
+        self.incoming_proposals.close()
+        self.incoming_reads.close()
+        self.mq.close()
+        self.pending_proposals.close()
+        self.pending_read_indexes.close()
+        self.pending_config_change.close()
+        self.pending_snapshot.close()
+        self.sm.offloaded()
+
+
+__all__ = ["Node"]

@@ -1,0 +1,167 @@
+"""Double-buffered producer/consumer queues between the API and the step
+workers (cf. queue.go:24-252 and internal/server/message.go:24-172).
+
+Producers append under a short lock; the step worker swaps the buffer out
+and walks it lock-free. The MessageQueue additionally carries a dedicated
+snapshot slot (an InstallSnapshot message bypasses capacity limits) and
+coalesces LocalTick counts instead of queuing one message per tick.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..types import Entry, Message, MessageType, SystemCtx
+
+
+class EntryQueue:
+    """cf. queue.go:24-108."""
+
+    def __init__(self, size: int = 2048) -> None:
+        self._size = size
+        self._mu = threading.Lock()
+        self._left: List[Entry] = []
+        self._right: List[Entry] = []
+        self._use_left = True
+        self.stopped = False
+        self._paused = False
+
+    def add(self, e: Entry) -> bool:
+        with self._mu:
+            if self.stopped or self._paused:
+                return False
+            buf = self._left if self._use_left else self._right
+            if len(buf) >= self._size:
+                self._paused = True
+                return False
+            buf.append(e)
+            return True
+
+    def get(self, paused: bool = False) -> List[Entry]:
+        with self._mu:
+            self._paused = paused
+            buf = self._left if self._use_left else self._right
+            self._use_left = not self._use_left
+            tgt = self._left if self._use_left else self._right
+            tgt.clear()
+            out = list(buf)
+            buf.clear()
+            return out
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            self._left.clear()
+            self._right.clear()
+
+
+class ReadIndexQueue:
+    """cf. queue.go:110-176; carries opaque request objects the node binds
+    to system contexts."""
+
+    def __init__(self, size: int = 4096) -> None:
+        self._size = size
+        self._mu = threading.Lock()
+        self._pending: List[object] = []
+        self.stopped = False
+
+    def add(self, req: object) -> bool:
+        with self._mu:
+            if self.stopped or len(self._pending) >= self._size:
+                return False
+            self._pending.append(req)
+            return True
+
+    def get(self) -> List[object]:
+        with self._mu:
+            out = self._pending
+            self._pending = []
+            return out
+
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            self._pending = []
+
+
+class MessageQueue:
+    """Receive queue with snapshot slot + tick coalescing
+    (cf. internal/server/message.go:24-172, node.go:1152-1159)."""
+
+    def __init__(self, size: int = 1024, max_bytes: int = 0) -> None:
+        self._size = size
+        self._max_bytes = max_bytes
+        self._mu = threading.Lock()
+        self._msgs: List[Message] = []
+        self._snapshot: Optional[Message] = None
+        self._tick_count = 0
+        self.stopped = False
+
+    def add(self, m: Message) -> bool:
+        with self._mu:
+            if self.stopped:
+                return False
+            if m.type == MessageType.LOCAL_TICK:
+                self._tick_count += 1
+                return True
+            if len(self._msgs) >= self._size:
+                return False
+            self._msgs.append(m)
+            return True
+
+    def add_snapshot(self, m: Message) -> bool:
+        with self._mu:
+            if self.stopped or self._snapshot is not None:
+                return False
+            self._snapshot = m
+            return True
+
+    def get(self) -> Tuple[List[Message], int]:
+        """Returns (messages, coalesced_tick_count); an InstallSnapshot
+        message is delivered first."""
+        with self._mu:
+            out: List[Message] = []
+            if self._snapshot is not None:
+                out.append(self._snapshot)
+                self._snapshot = None
+            out.extend(self._msgs)
+            self._msgs = []
+            ticks = self._tick_count
+            self._tick_count = 0
+            return out, ticks
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            self._msgs = []
+            self._snapshot = None
+
+
+class LeaderInfoQueue:
+    """Dedicated queue for leader-change notifications to the user listener
+    (cf. queue.go:213-252)."""
+
+    def __init__(self, size: int = 2048) -> None:
+        self._mu = threading.Lock()
+        self._size = size
+        self._q: List[object] = []
+        self.notify = threading.Event()
+
+    def add(self, info: object) -> None:
+        with self._mu:
+            if len(self._q) < self._size:
+                self._q.append(info)
+        self.notify.set()
+
+    def get_all(self) -> List[object]:
+        with self._mu:
+            out = self._q
+            self._q = []
+            self.notify.clear()
+            return out
+
+
+__all__ = ["EntryQueue", "ReadIndexQueue", "MessageQueue", "LeaderInfoQueue"]

@@ -1,0 +1,56 @@
+"""Quiesce manager: idle groups stop exchanging heartbeats.
+
+cf. quiesce.go:23-123 — after threshold = 10x election ticks with no user
+or protocol activity, the node enters quiesce: its peer receives
+quiesced_tick() (clock advances, no elections/heartbeats fire). Any new
+activity exits quiesce immediately. With thousands of mostly-idle groups
+this is what keeps the tick fanout affordable.
+"""
+from __future__ import annotations
+
+
+class QuiesceManager:
+    THRESHOLD_FACTOR = 10  # cf. quiesce.go:84-86
+
+    def __init__(self, enabled: bool, election_tick: int) -> None:
+        self.enabled = enabled
+        self.election_tick = election_tick
+        self.threshold = election_tick * self.THRESHOLD_FACTOR
+        self.current_tick = 0
+        self.idle_since = 0
+        self._quiesced = False
+        self.exit_grace = 0
+
+    def quiesced(self) -> bool:
+        return self.enabled and self._quiesced
+
+    def record_activity(self) -> None:
+        self.idle_since = self.current_tick
+        if self._quiesced:
+            self._quiesced = False
+            # brief grace window before re-entering (cf. quiesce.go newToQuiesce)
+            self.exit_grace = self.current_tick + self.election_tick
+
+    def try_enter_quiesce(self) -> None:
+        """Peer announced quiesce (Quiesce message exchange)."""
+        if self.enabled and not self._quiesced:
+            self._quiesced = True
+            self.idle_since = self.current_tick
+
+    def tick(self) -> bool:
+        """Advance; returns True when the peer should get a quiesced tick."""
+        self.current_tick += 1
+        if not self.enabled:
+            return False
+        if self._quiesced:
+            return True
+        if (
+            self.current_tick - self.idle_since >= self.threshold
+            and self.current_tick >= self.exit_grace
+        ):
+            self._quiesced = True
+            return True
+        return False
+
+
+__all__ = ["QuiesceManager"]

@@ -1,0 +1,568 @@
+"""NodeHost: the public facade hosting many Raft groups in one process.
+
+cf. nodehost.go:243-2103 — lifecycle of all groups, the tick fanout, the
+transport receive path, and every user-facing request method
+(propose/read/membership/snapshot/transfer) in both async (RequestState)
+and synchronous (Sync*) forms.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .client import Session
+from .config import Config, NodeHostConfig
+from .core.peer import PeerAddress
+from .engine.execengine import ExecEngine
+from .engine.node import Node
+from .engine.snapshotter import Snapshotter
+from .raftio import ErrNoBootstrapInfo, IMessageHandler
+from .requests import (
+    RequestError,
+    ErrClusterClosed,
+    ErrClusterNotFound,
+    ErrClusterNotReady,
+    ErrInvalidSession,
+    ErrRejected,
+    ErrTimeout,
+    RequestResult,
+    RequestState,
+    PendingLeaderTransfer,
+)
+from .rsm import SSRequest, SS_REQ_EXPORTED, SS_REQ_USER
+from .statemachine import Result, sm_type_of
+from .storage import LogReader, ShardedLogDB
+from .transport import Transport, loopback_factory
+from .transport.tcp import tcp_factory
+from .types import (
+    Bootstrap,
+    ConfigChange,
+    ConfigChangeType,
+    Membership,
+    Message,
+    MessageType,
+)
+
+
+class ErrClusterAlreadyExist(RequestError):
+    code = "cluster already exist"
+
+
+class ErrInvalidClusterSettings(RequestError):
+    code = "cluster settings are invalid"
+
+
+class ErrDeadlineNotSet(RequestError):
+    code = "deadline not set"
+
+
+class ClusterInfo:
+    """cf. nodehost.go GetNodeHostInfo ClusterInfo."""
+
+    def __init__(self, cluster_id, node_id, nodes, config_change_index, is_leader):
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.nodes = nodes
+        self.config_change_index = config_change_index
+        self.is_leader = is_leader
+
+
+class NodeHost(IMessageHandler):
+    def __init__(self, cfg: NodeHostConfig) -> None:
+        cfg.validate()
+        self.config = cfg
+        self._nodes_mu = threading.RLock()
+        self._nodes: Dict[int, Node] = {}
+        self._stopped = threading.Event()
+        # --- directories
+        if cfg.nodehost_dir:
+            self._dir = os.path.join(
+                cfg.nodehost_dir, cfg.raft_address.replace(":", "-")
+            )
+            os.makedirs(self._dir, exist_ok=True)
+            self._tmpdir = None
+        else:
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="dbtpu-")
+            self._dir = self._tmpdir.name
+        # --- logdb
+        if cfg.logdb_factory is not None:
+            self.logdb = cfg.logdb_factory(self._dir)
+        elif cfg.nodehost_dir:
+            self.logdb = ShardedLogDB(os.path.join(self._dir, "logdb"))
+        else:
+            self.logdb = ShardedLogDB()  # in-memory
+        # --- transport
+        if cfg.raft_rpc_factory is not None:
+            rpc_factory = cfg.raft_rpc_factory(cfg.get_listen_address())
+        else:
+            rpc_factory = tcp_factory(cfg.get_listen_address())
+        self.transport = Transport(
+            cfg.raft_address,
+            cfg.deployment_id,
+            rpc_factory,
+            send_queue_length=cfg.max_send_queue_size or 0,
+        )
+        self.transport.set_message_handler(self)
+        from .transport.chunks import Chunks  # lazy: needs snapshot dir root
+
+        self._chunks = Chunks(self)
+        self.transport.set_chunk_sink(self._chunks.add_chunk)
+        self.transport.start()
+        self._snapshot_lanes = threading.Semaphore(
+            8
+        )  # cap concurrent outbound streams (cf. StreamConnections)
+        # --- engine
+        self.engine = ExecEngine(self.logdb)
+        # --- tick loop
+        self._tick_ms = cfg.rtt_millisecond
+        self._tick_thread = threading.Thread(
+            target=self._tick_worker_main, name="nh-tick", daemon=True
+        )
+        self._tick_thread.start()
+        self._partitioned = False  # monkey-test knob
+
+    # ------------------------------------------------------------ properties
+    def raft_address(self) -> str:
+        return self.config.raft_address
+
+    def snapshot_dir_root(self) -> str:
+        return os.path.join(self._dir, "snapshots")
+
+    # --------------------------------------------------------------- lifecyle
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+            self._nodes.clear()
+        for n in nodes:
+            self.engine.remove_node(n.cluster_id)
+            n.close()
+        self.engine.stop()
+        self.transport.stop()
+        self.logdb.close()
+        if self._tick_thread.is_alive():
+            self._tick_thread.join(timeout=2)
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+
+    # ------------------------------------------------------------ start paths
+    def start_cluster(
+        self,
+        initial_members: Dict[int, str],
+        join: bool,
+        sm_factory: Callable,
+        cfg: Config,
+    ) -> None:
+        """cf. nodehost.go:431-475 StartCluster + startCluster:1476-1560.
+        sm_factory(cluster_id, node_id) returns an IStateMachine /
+        IConcurrentStateMachine / IOnDiskStateMachine."""
+        cfg.validate()
+        if self._stopped.is_set():
+            raise ErrClusterClosed()
+        cluster_id, node_id = cfg.cluster_id, cfg.node_id
+        with self._nodes_mu:
+            if cluster_id in self._nodes:
+                raise ErrClusterAlreadyExist()
+        if join and initial_members:
+            raise ErrInvalidClusterSettings()
+        probe = sm_factory(cluster_id, node_id)
+        smtype = sm_type_of(probe)
+        if hasattr(probe, "close"):
+            probe.close()
+        bootstrap, new_node = self._bootstrap_cluster(
+            initial_members, join, cfg, smtype
+        )
+        addresses = bootstrap.addresses if not join else {}
+        peer_addresses = [
+            PeerAddress(node_id=nid, address=addr)
+            for nid, addr in sorted(addresses.items())
+        ]
+        for nid, addr in addresses.items():
+            self.transport.nodes.add_node(cluster_id, nid, addr)
+        log_reader = LogReader(cluster_id, node_id, self.logdb)
+        snapshotter = Snapshotter(
+            self.snapshot_dir_root(), cluster_id, node_id, self.logdb
+        )
+        # restart path: position the window from snapshot + persisted log
+        # BEFORE the protocol core launches and reads it (node.go:553-583)
+        ss = snapshotter.get_most_recent_snapshot()
+        if not new_node or (ss is not None and not ss.is_empty()):
+            log_reader.load(ss)
+        node = Node(
+            cfg,
+            peer_addresses,
+            initial=bool(initial_members) and new_node,
+            new_node=new_node,
+            sm_factory=sm_factory,
+            log_reader=log_reader,
+            logdb=self.logdb,
+            snapshotter=snapshotter,
+            send_message=self._send_message,
+            engine=self.engine,
+            event_listener=self.config.raft_event_listener,
+        )
+        with self._nodes_mu:
+            self._nodes[cluster_id] = node
+        self.engine.add_node(node)
+        node.recover_initial_snapshot()
+
+    def _bootstrap_cluster(
+        self, initial_members, join, cfg: Config, smtype: int
+    ):
+        """cf. nodehost.go:1445-1474 bootstrapCluster."""
+        cluster_id, node_id = cfg.cluster_id, cfg.node_id
+        try:
+            bootstrap = self.logdb.get_bootstrap_info(cluster_id, node_id)
+            if not bootstrap.validate(initial_members or {}, join, smtype):
+                raise ErrInvalidClusterSettings()
+            return bootstrap, False
+        except ErrNoBootstrapInfo:
+            pass
+        members = {} if join else dict(initial_members or {})
+        if not join and cfg.is_witness is False and cfg.is_observer is False:
+            if not members:
+                raise ErrInvalidClusterSettings()
+        bootstrap = Bootstrap(addresses=members, join=join, type=smtype)
+        self.logdb.save_bootstrap_info(cluster_id, node_id, bootstrap)
+        return bootstrap, True
+
+    def stop_cluster(self, cluster_id: int) -> None:
+        """cf. nodehost.go StopCluster."""
+        with self._nodes_mu:
+            node = self._nodes.pop(cluster_id, None)
+        if node is None:
+            raise ErrClusterNotFound()
+        self.engine.remove_node(cluster_id)
+        node.close()
+
+    def has_node(self, cluster_id: int) -> bool:
+        with self._nodes_mu:
+            return cluster_id in self._nodes
+
+    def _get_node(self, cluster_id: int) -> Node:
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None:
+            raise ErrClusterNotFound()
+        return node
+
+    # ------------------------------------------------------- time conversion
+    def _to_ticks(self, timeout_s: float) -> int:
+        return max(1, int(timeout_s * 1000 / self._tick_ms))
+
+    # ---------------------------------------------------------------- writes
+    def propose(
+        self, session: Session, cmd: bytes, timeout_s: float
+    ) -> RequestState:
+        node = self._get_node(session.cluster_id)
+        return node.propose(session, cmd, self._to_ticks(timeout_s))
+
+    def sync_propose(
+        self, session: Session, cmd: bytes, timeout_s: float = 4.0
+    ) -> Result:
+        """cf. nodehost.go:514 SyncPropose."""
+        rs = self.propose(session, cmd, timeout_s)
+        r = rs.wait(timeout_s + 1.0)
+        return self._unwrap(r)
+
+    def _unwrap(self, r: RequestResult):
+        if r.completed:
+            return r.result
+        if r.timeout:
+            raise ErrTimeout()
+        if r.rejected:
+            raise ErrRejected()
+        if r.terminated:
+            raise ErrClusterClosed()
+        raise ErrClusterNotReady()  # dropped
+
+    # ----------------------------------------------------------------- reads
+    def read_index(self, cluster_id: int, timeout_s: float) -> RequestState:
+        node = self._get_node(cluster_id)
+        return node.read(self._to_ticks(timeout_s))
+
+    def sync_read(self, cluster_id: int, query, timeout_s: float = 4.0):
+        """Linearizable read (cf. nodehost.go:539 SyncRead)."""
+        rs = self.read_index(cluster_id, timeout_s)
+        r = rs.wait(timeout_s + 1.0)
+        self._unwrap(r)
+        return self.read_local_node(cluster_id, query)
+
+    def read_local_node(self, cluster_id: int, query):
+        """Must only be called after a successful read_index round
+        (cf. nodehost.go:808-820)."""
+        node = self._get_node(cluster_id)
+        return node.sm.lookup(query)
+
+    def stale_read(self, cluster_id: int, query):
+        node = self._get_node(cluster_id)
+        return node.sm.lookup(query)
+
+    # -------------------------------------------------------------- sessions
+    def get_noop_session(self, cluster_id: int) -> Session:
+        return Session.noop_session(cluster_id)
+
+    def sync_get_session(self, cluster_id: int, timeout_s: float = 4.0) -> Session:
+        """Register a client session (cf. nodehost.go SyncGetSession)."""
+        s = Session.new_session(cluster_id)
+        s.prepare_for_register()
+        self._sync_session_op(s, timeout_s)
+        s.prepare_for_propose()
+        return s
+
+    def sync_close_session(self, session: Session, timeout_s: float = 4.0) -> None:
+        session.prepare_for_unregister()
+        self._sync_session_op(session, timeout_s)
+
+    def _sync_session_op(self, session: Session, timeout_s: float) -> None:
+        node = self._get_node(session.cluster_id)
+        rs = node.propose(session, b"", self._to_ticks(timeout_s))
+        result = self._unwrap(rs.wait(timeout_s + 1.0))
+        if result.value != session.client_id:
+            raise ErrRejected()
+
+    # ------------------------------------------------------------ membership
+    def request_add_node(
+        self, cluster_id: int, node_id: int, address: str, cc_id: int = 0,
+        timeout_s: float = 4.0,
+    ) -> RequestState:
+        return self._request_config_change(
+            cluster_id, ConfigChangeType.ADD_NODE, node_id, address, cc_id, timeout_s
+        )
+
+    def request_delete_node(
+        self, cluster_id: int, node_id: int, cc_id: int = 0, timeout_s: float = 4.0
+    ) -> RequestState:
+        return self._request_config_change(
+            cluster_id, ConfigChangeType.REMOVE_NODE, node_id, "", cc_id, timeout_s
+        )
+
+    def request_add_observer(
+        self, cluster_id, node_id, address, cc_id=0, timeout_s=4.0
+    ) -> RequestState:
+        return self._request_config_change(
+            cluster_id, ConfigChangeType.ADD_OBSERVER, node_id, address, cc_id,
+            timeout_s,
+        )
+
+    def request_add_witness(
+        self, cluster_id, node_id, address, cc_id=0, timeout_s=4.0
+    ) -> RequestState:
+        return self._request_config_change(
+            cluster_id, ConfigChangeType.ADD_WITNESS, node_id, address, cc_id,
+            timeout_s,
+        )
+
+    def _request_config_change(
+        self, cluster_id, cctype, node_id, address, cc_id, timeout_s
+    ) -> RequestState:
+        node = self._get_node(cluster_id)
+        cc = ConfigChange(
+            config_change_id=cc_id, type=cctype, node_id=node_id, address=address
+        )
+        if address:
+            self.transport.nodes.add_node(cluster_id, node_id, address)
+        return node.request_config_change(cc, self._to_ticks(timeout_s))
+
+    def sync_request_add_node(self, cluster_id, node_id, address, cc_id=0,
+                              timeout_s=4.0) -> None:
+        rs = self.request_add_node(cluster_id, node_id, address, cc_id, timeout_s)
+        self._unwrap(rs.wait(timeout_s + 1.0))
+
+    def sync_request_delete_node(self, cluster_id, node_id, cc_id=0,
+                                 timeout_s=4.0) -> None:
+        rs = self.request_delete_node(cluster_id, node_id, cc_id, timeout_s)
+        self._unwrap(rs.wait(timeout_s + 1.0))
+
+    def sync_request_add_observer(self, cluster_id, node_id, address, cc_id=0,
+                                  timeout_s=4.0) -> None:
+        rs = self.request_add_observer(cluster_id, node_id, address, cc_id, timeout_s)
+        self._unwrap(rs.wait(timeout_s + 1.0))
+
+    def sync_request_add_witness(self, cluster_id, node_id, address, cc_id=0,
+                                 timeout_s=4.0) -> None:
+        rs = self.request_add_witness(cluster_id, node_id, address, cc_id, timeout_s)
+        self._unwrap(rs.wait(timeout_s + 1.0))
+
+    def get_cluster_membership(self, cluster_id: int) -> Membership:
+        node = self._get_node(cluster_id)
+        return node.sm.get_membership()
+
+    # ---------------------------------------------------- leadership / status
+    def get_leader_id(self, cluster_id: int):
+        """Returns (leader_node_id, has_leader)."""
+        node = self._get_node(cluster_id)
+        lid = node.get_leader_id()
+        return lid, lid != 0
+
+    def request_leader_transfer(self, cluster_id: int, target_node_id: int) -> None:
+        node = self._get_node(cluster_id)
+        node.request_leader_transfer(target_node_id)
+
+    def request_snapshot(
+        self, cluster_id: int, export_path: str = "", compaction_overhead: int = 0,
+        timeout_s: float = 10.0,
+    ) -> RequestState:
+        """cf. nodehost.go:877-949 RequestSnapshot (incl. exported)."""
+        node = self._get_node(cluster_id)
+        req = SSRequest(
+            type=SS_REQ_EXPORTED if export_path else SS_REQ_USER,
+            path=export_path,
+            override_compaction=compaction_overhead > 0,
+            compaction_overhead=compaction_overhead,
+        )
+        return node.request_snapshot(req, self._to_ticks(timeout_s))
+
+    def sync_request_snapshot(self, cluster_id: int, export_path: str = "",
+                              timeout_s: float = 10.0) -> int:
+        rs = self.request_snapshot(cluster_id, export_path, timeout_s=timeout_s)
+        r = rs.wait(timeout_s + 1.0)
+        if r.completed:
+            return r.snapshot_index
+        self._unwrap(r)
+
+    def get_nodehost_info(self) -> List[ClusterInfo]:
+        out = []
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            st = n.local_status()
+            m = n.sm.get_membership()
+            out.append(
+                ClusterInfo(
+                    cluster_id=n.cluster_id,
+                    node_id=n.node_id(),
+                    nodes=dict(m.addresses),
+                    config_change_index=m.config_change_id,
+                    is_leader=st["leader_id"] == n.node_id(),
+                )
+            )
+        return out
+
+    # ------------------------------------------------------------- transport
+    def _send_message(self, m: Message) -> None:
+        if self._partitioned:
+            return
+        if m.type == MessageType.INSTALL_SNAPSHOT:
+            self._async_send_snapshot(m)
+            return
+        self.transport.send(m)
+
+    def _async_send_snapshot(self, m: Message) -> None:
+        """Stream a snapshot to a lagging peer on a dedicated lane
+        (cf. nodehost.go:1724-1744 + transport snapshot.go:55-110)."""
+        from .transport.snapshotstream import SnapshotLane
+
+        addr = self.transport.nodes.resolve(m.cluster_id, m.to)
+        if addr is None:
+            self._report_snapshot_status(m.cluster_id, m.to, True)
+            return
+
+        def on_done(cluster_id: int, to: int, failed: bool) -> None:
+            self._report_snapshot_status(cluster_id, to, failed)
+
+        lane = SnapshotLane(
+            self.transport, addr, m, on_done, max_concurrent=self._snapshot_lanes
+        )
+        lane.start()
+
+    def _report_snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
+        # status lands in the sender's own raft (remote leaves Snapshot state)
+        self.handle_snapshot_status(cluster_id, node_id, failed)
+
+    def handle_message_batch(self, batch) -> None:
+        """Inbound traffic (cf. nodehost.go:1978-2026)."""
+        if self._partitioned:
+            return 0, 0
+        snapshot_count = msg_count = 0
+        for m in batch.requests:
+            if m.type == MessageType.SNAPSHOT_RECEIVED:
+                self._on_snapshot_received(m)
+                continue
+            with self._nodes_mu:
+                node = self._nodes.get(m.cluster_id)
+            if node is None:
+                continue
+            if m.to != node.node_id():
+                continue
+            if m.type == MessageType.INSTALL_SNAPSHOT:
+                if node.mq.add_snapshot(m):
+                    snapshot_count += 1
+            else:
+                if node.mq.add(m):
+                    msg_count += 1
+            self.engine.set_node_ready(m.cluster_id)
+        return snapshot_count, msg_count
+
+    def handle_unreachable(self, cluster_id: int, node_id: int) -> None:
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None:
+            return
+        node.mq.add(
+            Message(
+                type=MessageType.UNREACHABLE, cluster_id=cluster_id, from_=node_id
+            )
+        )
+        self.engine.set_node_ready(cluster_id)
+
+    def handle_snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None:
+            return
+        node.mq.add(
+            Message(
+                type=MessageType.SNAPSHOT_STATUS,
+                cluster_id=cluster_id,
+                from_=node_id,
+                reject=failed,
+            )
+        )
+        self.engine.set_node_ready(cluster_id)
+
+    def handle_snapshot(self, cluster_id: int, node_id: int, from_: int) -> None:
+        """A snapshot finished arriving: ack the sender
+        (cf. nodehost.go:2057-2067)."""
+        self.transport.send(
+            Message(
+                type=MessageType.SNAPSHOT_RECEIVED,
+                cluster_id=cluster_id,
+                to=from_,
+                from_=node_id,
+            )
+        )
+
+    def _on_snapshot_received(self, m: Message) -> None:
+        self.handle_snapshot_status(m.cluster_id, m.from_, False)
+
+    # ------------------------------------------------------------- tick loop
+    def _tick_worker_main(self) -> None:
+        """cf. nodehost.go:1668-1684 tickWorkerMain."""
+        period = self._tick_ms / 1000.0
+        next_t = time.monotonic() + period
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(period, next_t - now))
+                continue
+            # catch-up ticks are coalesced by the MessageQueue counter
+            while next_t <= now:
+                next_t += period
+                with self._nodes_mu:
+                    nodes = list(self._nodes.values())
+                for n in nodes:
+                    n.mq.add(Message(type=MessageType.LOCAL_TICK))
+                    self.engine.set_node_ready(n.cluster_id)
+                self._chunks.tick()  # abandoned inbound stream GC
+
+
+__all__ = [
+    "NodeHost",
+    "ClusterInfo",
+    "ErrClusterAlreadyExist",
+    "ErrInvalidClusterSettings",
+]

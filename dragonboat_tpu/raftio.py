@@ -1,0 +1,264 @@
+"""Pluggable infrastructure seams: log storage, transport, event listeners.
+
+The equivalent of the reference's `raftio/` package: ILogDB is the stable
+log storage contract (cf. raftio/logdb.go:99-147), IRaftRPC the transport
+contract (cf. raftio/rpc.go:90-105), and the listener interfaces mirror
+raftio/listener.go. Implementations live in storage/ and transport/; users
+can supply their own through NodeHostConfig factories.
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .types import Entry, Membership, Message, MessageBatch, Snapshot, SnapshotChunk, State, Update
+
+
+class ErrNoSavedLog(Exception):
+    """No saved state found for the node (cf. raftio/logdb.go ErrNoSavedLog)."""
+
+
+class ErrNoBootstrapInfo(Exception):
+    """No bootstrap record found (cf. raftio/logdb.go ErrNoBootstrapInfo)."""
+
+
+@dataclass(slots=True)
+class NodeInfo:
+    cluster_id: int = 0
+    node_id: int = 0
+
+
+@dataclass(slots=True)
+class RaftState:
+    """State + log range returned by ReadRaftState
+    (cf. raftio/logdb.go RaftState)."""
+
+    state: State = None
+    first_index: int = 0
+    entry_count: int = 0
+
+
+class ILogDB(abc.ABC):
+    """Stable storage of Raft states, entries, snapshots and bootstrap
+    records for all groups in a NodeHost (cf. raftio/logdb.go:99-147).
+
+    save_raft_state persists a batch of Updates from many groups in ONE
+    atomic+fsynced write — the engine's whole-worker batching depends on it
+    (cf. internal/logdb/sharded_rdb.go:149-156)."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def list_node_info(self) -> List[NodeInfo]: ...
+
+    @abc.abstractmethod
+    def save_bootstrap_info(
+        self, cluster_id: int, node_id: int, bootstrap
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def get_bootstrap_info(self, cluster_id: int, node_id: int): ...
+
+    @abc.abstractmethod
+    def save_raft_state(self, updates: Sequence[Update], shard_id: int = 0) -> None: ...
+
+    @abc.abstractmethod
+    def read_raft_state(
+        self, cluster_id: int, node_id: int, last_index: int
+    ) -> RaftState: ...
+
+    @abc.abstractmethod
+    def iterate_entries(
+        self,
+        cluster_id: int,
+        node_id: int,
+        low: int,
+        high: int,
+        max_size: int,
+    ) -> Tuple[List[Entry], int]:
+        """Entries in [low, high) up to max_size bytes; returns (entries,
+        total_size)."""
+
+    @abc.abstractmethod
+    def remove_entries_to(self, cluster_id: int, node_id: int, index: int) -> None: ...
+
+    @abc.abstractmethod
+    def compact_entries_to(self, cluster_id: int, node_id: int, index: int) -> None: ...
+
+    @abc.abstractmethod
+    def save_snapshots(self, updates: Sequence[Update]) -> None: ...
+
+    @abc.abstractmethod
+    def delete_snapshot(self, cluster_id: int, node_id: int, index: int) -> None: ...
+
+    @abc.abstractmethod
+    def list_snapshots(
+        self, cluster_id: int, node_id: int, index: int
+    ) -> List[Snapshot]: ...
+
+    @abc.abstractmethod
+    def remove_node_data(self, cluster_id: int, node_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def import_snapshot(self, ss: Snapshot, node_id: int) -> None: ...
+
+
+class IConnection(abc.ABC):
+    """An established transport connection to a remote NodeHost
+    (cf. raftio/rpc.go:30-45)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def send_message_batch(self, batch: MessageBatch) -> None: ...
+
+
+class ISnapshotConnection(abc.ABC):
+    """Connection used to stream snapshot chunks (cf. raftio/rpc.go:47-62)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def send_chunk(self, chunk: SnapshotChunk) -> None: ...
+
+
+class IRaftRPC(abc.ABC):
+    """The pluggable transport module (cf. raftio/rpc.go:90-105)."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def start(self) -> None: ...
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_connection(self, target: str) -> IConnection: ...
+
+    @abc.abstractmethod
+    def get_snapshot_connection(self, target: str) -> ISnapshotConnection: ...
+
+
+# Handler callbacks installed by the NodeHost into the RPC module
+# (cf. raftio/rpc.go RequestHandler / ChunkSinkFactory).
+RequestHandler = Callable[[MessageBatch], None]
+ChunkHandler = Callable[[SnapshotChunk], bool]
+
+
+@dataclass(slots=True)
+class LeaderInfo:
+    cluster_id: int = 0
+    node_id: int = 0
+    term: int = 0
+    leader_id: int = 0
+
+
+class IRaftEventListener(abc.ABC):
+    """User callback for leadership events (cf. raftio/listener.go:31-35)."""
+
+    @abc.abstractmethod
+    def leader_updated(self, info: LeaderInfo) -> None: ...
+
+
+@dataclass(slots=True)
+class EntryInfo:
+    cluster_id: int = 0
+    node_id: int = 0
+    index: int = 0
+
+
+@dataclass(slots=True)
+class SnapshotInfo:
+    cluster_id: int = 0
+    node_id: int = 0
+    from_: int = 0
+    index: int = 0
+
+
+@dataclass(slots=True)
+class ConnectionInfo:
+    address: str = ""
+    snapshot_connection: bool = False
+
+
+class ISystemEventListener(abc.ABC):
+    """Optional process-level event callbacks (cf. config.SystemEventListener
+    in the v3.3 line of the reference; subset relevant here)."""
+
+    def node_ready(self, info: NodeInfo) -> None: ...
+
+    def node_unloaded(self, info: NodeInfo) -> None: ...
+
+    def membership_changed(self, info: NodeInfo) -> None: ...
+
+    def connection_established(self, info: ConnectionInfo) -> None: ...
+
+    def connection_failed(self, info: ConnectionInfo) -> None: ...
+
+    def send_snapshot_started(self, info: SnapshotInfo) -> None: ...
+
+    def send_snapshot_completed(self, info: SnapshotInfo) -> None: ...
+
+    def send_snapshot_aborted(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_received(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_recovered(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_created(self, info: SnapshotInfo) -> None: ...
+
+    def snapshot_compacted(self, info: SnapshotInfo) -> None: ...
+
+    def log_compacted(self, info: EntryInfo) -> None: ...
+
+    def log_db_compacted(self, info: EntryInfo) -> None: ...
+
+
+class IMessageHandler(abc.ABC):
+    """Installed by NodeHost to receive inbound traffic
+    (cf. internal/transport/transport.go:100-105)."""
+
+    @abc.abstractmethod
+    def handle_message_batch(self, batch: MessageBatch) -> Tuple[int, int]:
+        """Returns (snapshot_count, msg_count) accepted."""
+
+    @abc.abstractmethod
+    def handle_unreachable(self, cluster_id: int, node_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def handle_snapshot_status(
+        self, cluster_id: int, node_id: int, failed: bool
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def handle_snapshot(self, cluster_id: int, node_id: int, from_: int) -> None: ...
+
+
+__all__ = [
+    "ErrNoSavedLog",
+    "ErrNoBootstrapInfo",
+    "NodeInfo",
+    "RaftState",
+    "ILogDB",
+    "IConnection",
+    "ISnapshotConnection",
+    "IRaftRPC",
+    "RequestHandler",
+    "ChunkHandler",
+    "LeaderInfo",
+    "EntryInfo",
+    "SnapshotInfo",
+    "ConnectionInfo",
+    "IRaftEventListener",
+    "ISystemEventListener",
+    "IMessageHandler",
+]

@@ -1,0 +1,509 @@
+"""Async request plumbing: pending proposals, reads, config changes,
+snapshots, leader transfers.
+
+cf. requests.go:48-1133 — every user request becomes a RequestState with a
+completion event; timeouts are enforced by a logical clock advanced on the
+NodeHost tick so no per-request timers exist. Proposals are keyed (the key
+rides in the entry and comes back from the apply path), ReadIndex requests
+batch many user reads under one 128-bit system context.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .client import Session
+from .statemachine import Result
+from .types import (
+    Entry,
+    EntryType,
+    ConfigChange,
+    Membership,
+    Snapshot,
+    SystemCtx,
+)
+
+
+class RequestError(Exception):
+    code = "request error"
+
+
+class ErrClusterNotFound(RequestError):
+    code = "cluster not found"
+
+
+class ErrClusterNotReady(RequestError):
+    code = "cluster not ready"
+
+
+class ErrClusterClosed(RequestError):
+    code = "raft cluster already closed"
+
+
+class ErrTimeout(RequestError):
+    code = "timeout"
+
+
+class ErrCanceled(RequestError):
+    code = "request canceled"
+
+
+class ErrRejected(RequestError):
+    code = "request rejected"
+
+
+class ErrSystemBusy(RequestError):
+    code = "system is too busy, try again later"
+
+
+class ErrInvalidSession(RequestError):
+    code = "invalid session"
+
+
+class ErrTimeoutTooSmall(RequestError):
+    code = "timeout is too small"
+
+
+class ErrPayloadTooBig(RequestError):
+    code = "payload is too big"
+
+
+class ErrSystemStopped(RequestError):
+    code = "system stopped"
+
+
+# request completion codes (cf. requests.go RequestResultCode)
+REQUEST_TIMEOUT = 0
+REQUEST_COMPLETED = 1
+REQUEST_TERMINATED = 2
+REQUEST_REJECTED = 3
+REQUEST_DROPPED = 4
+
+
+@dataclass
+class RequestResult:
+    code: int = REQUEST_TIMEOUT
+    result: Result = field(default_factory=Result)
+    snapshot_index: int = 0
+
+    @property
+    def completed(self) -> bool:
+        return self.code == REQUEST_COMPLETED
+
+    @property
+    def timeout(self) -> bool:
+        return self.code == REQUEST_TIMEOUT
+
+    @property
+    def terminated(self) -> bool:
+        return self.code == REQUEST_TERMINATED
+
+    @property
+    def rejected(self) -> bool:
+        return self.code == REQUEST_REJECTED
+
+    @property
+    def dropped(self) -> bool:
+        return self.code == REQUEST_DROPPED
+
+
+class RequestState:
+    """One in-flight request (cf. requests.go:267-329). wait() blocks the
+    calling thread; the engine thread completes it via notify()."""
+
+    __slots__ = ("key", "client_id", "series_id", "deadline", "_event", "_result")
+
+    def __init__(self) -> None:
+        self.key = 0
+        self.client_id = 0
+        self.series_id = 0
+        self.deadline = 0
+        self._event = threading.Event()
+        self._result: Optional[RequestResult] = None
+
+    def notify(self, result: RequestResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> RequestResult:
+        if not self._event.wait(timeout):
+            return RequestResult(code=REQUEST_TIMEOUT)
+        return self._result
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def result(self) -> Optional[RequestResult]:
+        return self._result
+
+
+class LogicalClock:
+    """Tick-driven clock for request GC (cf. requests.go:223-241)."""
+
+    __slots__ = ("tick", "last_gc_time", "gc_tick")
+
+    GC_TICK = 2
+
+    def __init__(self) -> None:
+        self.tick = 0
+        self.last_gc_time = 0
+
+    def increase_tick(self) -> None:
+        self.tick += 1
+
+    def should_gc(self) -> bool:
+        if self.tick - self.last_gc_time >= self.GC_TICK:
+            self.last_gc_time = self.tick
+            return True
+        return False
+
+
+class PendingProposal:
+    """Keyed in-flight proposals (cf. proposalShard requests.go:983-1133;
+    the reference shards 16-ways to cut mutex contention — under the GIL a
+    single dict+lock serves the same role)."""
+
+    def __init__(self, clock: LogicalClock) -> None:
+        self._mu = threading.Lock()
+        self._pending: Dict[int, RequestState] = {}
+        self._clock = clock
+        self._key_seq = itertools.count(
+            int.from_bytes(os.urandom(6), "big") << 16
+        )
+        self.stopped = False
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> Tuple[RequestState, Entry]:
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        rs = RequestState()
+        rs.key = next(self._key_seq)
+        rs.client_id = session.client_id
+        rs.series_id = session.series_id
+        rs.deadline = self._clock.tick + timeout_ticks
+        entry = Entry(
+            key=rs.key,
+            client_id=session.client_id,
+            series_id=session.series_id,
+            responded_to=session.responded_to,
+            cmd=cmd,
+        )
+        with self._mu:
+            if self.stopped:
+                raise ErrClusterClosed()
+            self._pending[rs.key] = rs
+        return rs, entry
+
+    def applied(
+        self, key: int, client_id: int, series_id: int, result: Result,
+        rejected: bool,
+    ) -> None:
+        """Apply-path notification (cf. requests.go:1086-1103)."""
+        with self._mu:
+            rs = self._pending.get(key)
+            if rs is None:
+                return
+            if rs.client_id != client_id or rs.series_id != series_id:
+                return
+            del self._pending[key]
+        code = REQUEST_REJECTED if rejected else REQUEST_COMPLETED
+        rs.notify(RequestResult(code=code, result=result))
+
+    def dropped(self, key: int) -> None:
+        with self._mu:
+            rs = self._pending.pop(key, None)
+        if rs is not None:
+            rs.notify(RequestResult(code=REQUEST_DROPPED))
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for rs in pending:
+            rs.notify(RequestResult(code=REQUEST_TERMINATED))
+
+    def gc(self) -> None:
+        if not self._clock.should_gc():
+            return
+        now = self._clock.tick
+        with self._mu:
+            expired = [k for k, rs in self._pending.items() if rs.deadline < now]
+            states = [self._pending.pop(k) for k in expired]
+        for rs in states:
+            rs.notify(RequestResult(code=REQUEST_TIMEOUT))
+
+
+class PendingReadIndex:
+    """ReadIndex batching: many user reads share one system context
+    (cf. requests.go:654-886)."""
+
+    def __init__(self, clock: LogicalClock) -> None:
+        self._mu = threading.Lock()
+        self._clock = clock
+        # reads queued but not yet bound to a ctx
+        self._queued: List[RequestState] = []
+        # ctx -> (bound reads, ready index or None)
+        self._batches: Dict[SystemCtx, List[RequestState]] = {}
+        self._ready: List[Tuple[SystemCtx, int]] = []  # confirmed, awaiting apply
+        self._ctx_seq = itertools.count(1)
+        self.stopped = False
+
+    def read(self, timeout_ticks: int) -> RequestState:
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        rs = RequestState()
+        rs.deadline = self._clock.tick + timeout_ticks
+        with self._mu:
+            if self.stopped:
+                raise ErrClusterClosed()
+            self._queued.append(rs)
+        return rs
+
+    def has_queued(self) -> bool:
+        return bool(self._queued)
+
+    def next_ctx(self) -> SystemCtx:
+        return SystemCtx(
+            low=next(self._ctx_seq),
+            high=int.from_bytes(os.urandom(8), "big") | 1,
+        )
+
+    def bind_queued(self, ctx: SystemCtx) -> bool:
+        """Engine: bind all queued reads to ctx before Peer.read_index(ctx)
+        (cf. nextReadIndexCtx/peepNextCtx requests.go:732-778)."""
+        with self._mu:
+            if not self._queued:
+                return False
+            self._batches[ctx] = self._queued
+            self._queued = []
+        return True
+
+    def bind_queued_states(self, states: List[RequestState], ctx: SystemCtx) -> bool:
+        """Bind an explicit batch popped from the node's read queue; the
+        states were registered in _queued by read() and move to the ctx."""
+        if not states:
+            return False
+        with self._mu:
+            qs = set(map(id, states))
+            self._queued = [rs for rs in self._queued if id(rs) not in qs]
+            live = [rs for rs in states if not rs.done()]
+            if not live:
+                return False
+            self._batches[ctx] = live
+        return True
+
+    def add_ready_to_read(self, ready: List) -> None:
+        """Update.ready_to_reads arrived (cf. addReadyToRead)."""
+        if not ready:
+            return
+        with self._mu:
+            for r in ready:
+                if r.system_ctx in self._batches:
+                    self._ready.append((r.system_ctx, r.index))
+
+    def applied(self, applied_index: int) -> None:
+        """SM applied up to applied_index: release confirmed reads whose
+        read index is covered (cf. requests.go:798-858)."""
+        done: List[Tuple[List[RequestState], int]] = []
+        with self._mu:
+            if not self._ready:
+                return
+            remaining = []
+            for ctx, idx in self._ready:
+                if idx <= applied_index:
+                    states = self._batches.pop(ctx, [])
+                    done.append((states, idx))
+                else:
+                    remaining.append((ctx, idx))
+            self._ready = remaining
+        for states, _ in done:
+            for rs in states:
+                rs.notify(RequestResult(code=REQUEST_COMPLETED))
+
+    def dropped(self, ctx: SystemCtx) -> None:
+        with self._mu:
+            states = self._batches.pop(ctx, [])
+        for rs in states:
+            rs.notify(RequestResult(code=REQUEST_DROPPED))
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            states = list(self._queued)
+            self._queued = []
+            for batch in self._batches.values():
+                states.extend(batch)
+            self._batches.clear()
+            self._ready = []
+        for rs in states:
+            rs.notify(RequestResult(code=REQUEST_TERMINATED))
+
+    def gc(self) -> None:
+        if not self._clock.should_gc():
+            return
+        now = self._clock.tick
+        expired: List[RequestState] = []
+        with self._mu:
+            keep = []
+            for rs in self._queued:
+                (expired if rs.deadline < now else keep).append(rs)
+            self._queued = keep
+            for ctx in list(self._batches):
+                batch = self._batches[ctx]
+                live = [rs for rs in batch if rs.deadline >= now]
+                expired.extend(rs for rs in batch if rs.deadline < now)
+                if live:
+                    self._batches[ctx] = live
+                else:
+                    del self._batches[ctx]
+                    self._ready = [(c, i) for c, i in self._ready if c != ctx]
+        for rs in expired:
+            rs.notify(RequestResult(code=REQUEST_TIMEOUT))
+
+
+class _SingleSlotPending:
+    """Base for config-change / snapshot / transfer requests: at most one
+    outstanding request per node (cf. pendingConfigChange requests.go:388-393)."""
+
+    def __init__(self, clock: LogicalClock) -> None:
+        self._mu = threading.Lock()
+        self._clock = clock
+        self._pending: Optional[RequestState] = None
+        self._key_seq = itertools.count(1)
+        self.stopped = False
+
+    def _request(self, timeout_ticks: int) -> RequestState:
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        rs = RequestState()
+        rs.key = next(self._key_seq)
+        rs.deadline = self._clock.tick + timeout_ticks
+        with self._mu:
+            if self.stopped:
+                raise ErrClusterClosed()
+            if self._pending is not None:
+                raise ErrSystemBusy()
+            self._pending = rs
+        return rs
+
+    def _take(self, key: Optional[int] = None) -> Optional[RequestState]:
+        with self._mu:
+            rs = self._pending
+            if rs is None:
+                return None
+            if key is not None and rs.key != key:
+                return None
+            self._pending = None
+        return rs
+
+    def close(self) -> None:
+        with self._mu:
+            self.stopped = True
+            rs = self._pending
+            self._pending = None
+        if rs is not None:
+            rs.notify(RequestResult(code=REQUEST_TERMINATED))
+
+    def gc(self) -> None:
+        if not self._clock.should_gc():
+            return
+        now = self._clock.tick
+        with self._mu:
+            rs = self._pending
+            if rs is None or rs.deadline >= now:
+                return
+            self._pending = None
+        rs.notify(RequestResult(code=REQUEST_TIMEOUT))
+
+
+class PendingConfigChange(_SingleSlotPending):
+    def request(
+        self, cc: ConfigChange, timeout_ticks: int
+    ) -> Tuple[RequestState, ConfigChange, int]:
+        rs = self._request(timeout_ticks)
+        return rs, cc, rs.key
+
+    def apply(self, key: int, rejected: bool) -> None:
+        rs = self._take(key)
+        if rs is not None:
+            code = REQUEST_REJECTED if rejected else REQUEST_COMPLETED
+            rs.notify(RequestResult(code=code))
+
+    def dropped(self, key: int) -> None:
+        rs = self._take(key)
+        if rs is not None:
+            rs.notify(RequestResult(code=REQUEST_DROPPED))
+
+
+class PendingSnapshot(_SingleSlotPending):
+    def request(self, req, timeout_ticks: int) -> Tuple[RequestState, object]:
+        rs = self._request(timeout_ticks)
+        return rs, req
+
+    def apply(self, index: int, ignored: bool, failed: bool = False) -> None:
+        rs = self._take()
+        if rs is None:
+            return
+        if ignored or failed:
+            rs.notify(RequestResult(code=REQUEST_REJECTED))
+        else:
+            rs.notify(
+                RequestResult(code=REQUEST_COMPLETED, snapshot_index=index)
+            )
+
+
+class PendingLeaderTransfer:
+    """cf. requests.go:402-431; completion is observed via leadership
+    change events rather than an apply callback."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._target: Optional[int] = None
+
+    def request(self, target: int) -> None:
+        with self._mu:
+            if self._target is not None:
+                raise ErrSystemBusy()
+            self._target = target
+
+    def get(self) -> Optional[int]:
+        with self._mu:
+            t = self._target
+            self._target = None
+            return t
+
+
+__all__ = [
+    "RequestError",
+    "ErrClusterNotFound",
+    "ErrClusterNotReady",
+    "ErrClusterClosed",
+    "ErrTimeout",
+    "ErrCanceled",
+    "ErrRejected",
+    "ErrSystemBusy",
+    "ErrInvalidSession",
+    "ErrTimeoutTooSmall",
+    "ErrPayloadTooBig",
+    "ErrSystemStopped",
+    "REQUEST_TIMEOUT",
+    "REQUEST_COMPLETED",
+    "REQUEST_TERMINATED",
+    "REQUEST_REJECTED",
+    "REQUEST_DROPPED",
+    "RequestResult",
+    "RequestState",
+    "LogicalClock",
+    "PendingProposal",
+    "PendingReadIndex",
+    "PendingConfigChange",
+    "PendingSnapshot",
+    "PendingLeaderTransfer",
+]

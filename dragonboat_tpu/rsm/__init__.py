@@ -1,0 +1,60 @@
+"""Replicated state machine management layer (cf. internal/rsm/)."""
+
+from .managed import (
+    ConcurrentManaged,
+    ManagedStateMachine,
+    OnDiskManaged,
+    RegularManaged,
+    wrap_state_machine,
+)
+from .manager import (
+    INodeProxy,
+    ISnapshotter,
+    SSMeta,
+    SSRequest,
+    SS_REQ_EXPORTED,
+    SS_REQ_PERIODIC,
+    SS_REQ_STREAM,
+    SS_REQ_USER,
+    StateMachineManager,
+    Task,
+    TaskQueue,
+)
+from .membership import MembershipManager
+from .session import Session, SessionManager
+from .snapshotio import (
+    SnapshotCorrupted,
+    SnapshotHeader,
+    SnapshotReader,
+    SnapshotWriter,
+    StreamValidator,
+    validate_snapshot_file,
+)
+
+__all__ = [
+    "ManagedStateMachine",
+    "RegularManaged",
+    "ConcurrentManaged",
+    "OnDiskManaged",
+    "wrap_state_machine",
+    "StateMachineManager",
+    "Task",
+    "TaskQueue",
+    "SSRequest",
+    "SSMeta",
+    "SS_REQ_PERIODIC",
+    "SS_REQ_USER",
+    "SS_REQ_EXPORTED",
+    "SS_REQ_STREAM",
+    "INodeProxy",
+    "ISnapshotter",
+    "MembershipManager",
+    "Session",
+    "SessionManager",
+    "SnapshotHeader",
+    "SnapshotReader",
+    "SnapshotWriter",
+    "SnapshotCorrupted",
+    "StreamValidator",
+    "validate_snapshot_file",
+]

@@ -1,0 +1,209 @@
+"""Managed state machine adapters.
+
+Uniform IManagedStateMachine interface over the three user SM types
+(cf. internal/rsm/native.go:33-290 and internal/rsm/sm.go:26-382). The
+manager layer (rsm.manager.StateMachineManager) talks only to this
+interface; whether the user implemented a regular, concurrent, or on-disk
+SM is hidden behind it, including the locking discipline:
+
+  - regular: update and lookup serialized by one mutex
+  - concurrent: updates serialized; lookups + snapshot saves concurrent
+  - on-disk: like concurrent, plus open()/sync() and streamed snapshots
+"""
+from __future__ import annotations
+
+import threading
+from typing import BinaryIO, List, Optional, Tuple
+
+from ..statemachine import (
+    SM_TYPE_CONCURRENT,
+    SM_TYPE_ONDISK,
+    SM_TYPE_REGULAR,
+    AbortSignal,
+    IConcurrentStateMachine,
+    IOnDiskStateMachine,
+    IStateMachine,
+    ISnapshotFileCollection,
+    Result,
+    SMEntry,
+    SnapshotFile,
+)
+
+
+class ManagedStateMachine:
+    """Adapter base (cf. IManagedStateMachine internal/rsm/native.go:56)."""
+
+    def __init__(self, sm, cluster_id: int, node_id: int) -> None:
+        self._sm = sm
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self._mu = threading.RLock()
+        self._destroyed = False
+
+    # ---- type predicates
+    def concurrent_snapshot(self) -> bool:
+        return False
+
+    def on_disk(self) -> bool:
+        return False
+
+    def sm_type(self) -> int:
+        raise NotImplementedError
+
+    # ---- lifecycle
+    def open(self, stopc: AbortSignal) -> int:
+        raise RuntimeError("open called on non-disk SM")
+
+    def sync(self) -> None:
+        return None
+
+    def destroy(self) -> None:
+        with self._mu:
+            if not self._destroyed:
+                self._destroyed = True
+                self._sm.close()
+
+    # ---- apply / read
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        raise NotImplementedError
+
+    def lookup(self, query: object) -> object:
+        raise NotImplementedError
+
+    # ---- snapshot
+    def prepare_snapshot(self) -> object:
+        return None
+
+    def save_snapshot(
+        self,
+        ctx: object,
+        w: BinaryIO,
+        files: Optional[ISnapshotFileCollection],
+        done: AbortSignal,
+    ) -> None:
+        raise NotImplementedError
+
+    def recover_from_snapshot(
+        self, r: BinaryIO, files: List[SnapshotFile], done: AbortSignal
+    ) -> None:
+        raise NotImplementedError
+
+
+class RegularManaged(ManagedStateMachine):
+    """cf. internal/rsm/sm.go RegularStateMachine (:45)."""
+
+    def sm_type(self) -> int:
+        return SM_TYPE_REGULAR
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        with self._mu:
+            for e in entries:
+                e.result = self._sm.update(e.cmd)
+        return entries
+
+    def lookup(self, query: object) -> object:
+        with self._mu:
+            if self._destroyed:
+                raise RuntimeError("lookup on destroyed state machine")
+            return self._sm.lookup(query)
+
+    def save_snapshot(self, ctx, w, files, done) -> None:
+        with self._mu:
+            self._sm.save_snapshot(w, files, done)
+
+    def recover_from_snapshot(self, r, files, done) -> None:
+        with self._mu:
+            self._sm.recover_from_snapshot(r, files, done)
+
+
+class ConcurrentManaged(ManagedStateMachine):
+    """cf. internal/rsm/sm.go ConcurrentStateMachine (:151). Snapshot save
+    runs WITHOUT the update mutex — prepare captures the point-in-time view
+    under the mutex, save streams it concurrently."""
+
+    def concurrent_snapshot(self) -> bool:
+        return True
+
+    def sm_type(self) -> int:
+        return SM_TYPE_CONCURRENT
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        with self._mu:
+            return self._sm.update(entries)
+
+    def lookup(self, query: object) -> object:
+        if self._destroyed:
+            raise RuntimeError("lookup on destroyed state machine")
+        return self._sm.lookup(query)
+
+    def prepare_snapshot(self) -> object:
+        with self._mu:
+            return self._sm.prepare_snapshot()
+
+    def save_snapshot(self, ctx, w, files, done) -> None:
+        self._sm.save_snapshot(ctx, w, files, done)
+
+    def recover_from_snapshot(self, r, files, done) -> None:
+        with self._mu:
+            self._sm.recover_from_snapshot(r, files, done)
+
+
+class OnDiskManaged(ManagedStateMachine):
+    """cf. internal/rsm/sm.go OnDiskStateMachine. The SM owns its own
+    durable state; snapshots stream live state to peers and recovery is
+    open() + optional stream apply."""
+
+    def concurrent_snapshot(self) -> bool:
+        return True
+
+    def on_disk(self) -> bool:
+        return True
+
+    def sm_type(self) -> int:
+        return SM_TYPE_ONDISK
+
+    def open(self, stopc: AbortSignal) -> int:
+        with self._mu:
+            return self._sm.open(stopc)
+
+    def sync(self) -> None:
+        self._sm.sync()
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        with self._mu:
+            return self._sm.update(entries)
+
+    def lookup(self, query: object) -> object:
+        if self._destroyed:
+            raise RuntimeError("lookup on destroyed state machine")
+        return self._sm.lookup(query)
+
+    def prepare_snapshot(self) -> object:
+        with self._mu:
+            return self._sm.prepare_snapshot()
+
+    def save_snapshot(self, ctx, w, files, done) -> None:
+        self._sm.save_snapshot(ctx, w, done)
+
+    def recover_from_snapshot(self, r, files, done) -> None:
+        with self._mu:
+            self._sm.recover_from_snapshot(r, done)
+
+
+def wrap_state_machine(sm, cluster_id: int, node_id: int) -> ManagedStateMachine:
+    if isinstance(sm, IOnDiskStateMachine):
+        return OnDiskManaged(sm, cluster_id, node_id)
+    if isinstance(sm, IConcurrentStateMachine):
+        return ConcurrentManaged(sm, cluster_id, node_id)
+    if isinstance(sm, IStateMachine):
+        return RegularManaged(sm, cluster_id, node_id)
+    raise TypeError(f"unsupported state machine type: {type(sm)!r}")
+
+
+__all__ = [
+    "ManagedStateMachine",
+    "RegularManaged",
+    "ConcurrentManaged",
+    "OnDiskManaged",
+    "wrap_state_machine",
+]

@@ -1,0 +1,175 @@
+"""Membership image applied inside the replicated state machine.
+
+Validated membership (addresses/observers/witnesses/removed + config-change
+id ordering) is itself replicated state: every replica applies config-change
+entries through the same legality checks so the image stays identical
+(cf. internal/rsm/membership.go:55-298).
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Optional
+
+from ..config import Config
+from ..types import ConfigChange, ConfigChangeType, Membership
+
+
+class MembershipManager:
+    def __init__(
+        self, cluster_id: int, node_id: int, ordered: bool = False
+    ) -> None:
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.ordered = ordered
+        self.members = Membership()
+
+    # -- snapshot interface ---------------------------------------------------
+    def get_membership(self) -> Membership:
+        return self.members.copy()
+
+    def set_membership(self, m: Membership) -> None:
+        self.members = m.copy()
+
+    def hash(self) -> int:
+        """Deterministic digest (cf. membership.go GetHash)."""
+        m = self.members
+        parts = [struct.pack("<Q", m.config_change_id)]
+        for nid in sorted(m.addresses):
+            parts.append(struct.pack("<Q", nid) + m.addresses[nid].encode())
+        for nid in sorted(m.observers):
+            parts.append(b"o" + struct.pack("<Q", nid))
+        for nid in sorted(m.witnesses):
+            parts.append(b"w" + struct.pack("<Q", nid))
+        for nid in sorted(m.removed):
+            parts.append(b"r" + struct.pack("<Q", nid))
+    # crc of the canonical serialization; identical across replicas by
+    # construction
+        return zlib.crc32(b"".join(parts))
+
+    def is_empty(self) -> bool:
+        return len(self.members.addresses) == 0
+
+    # -- legality (cf. membership.go:133-262) ---------------------------------
+    def is_conf_change_up_to_date(self, cc: ConfigChange) -> bool:
+        if not self.ordered or cc.initialize:
+            return True
+        return self.members.config_change_id == cc.config_change_id
+
+    def is_add_removed_node(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type
+            in (
+                ConfigChangeType.ADD_NODE,
+                ConfigChangeType.ADD_OBSERVER,
+                ConfigChangeType.ADD_WITNESS,
+            )
+            and cc.node_id in self.members.removed
+        )
+
+    def is_promote_observer(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type == ConfigChangeType.ADD_NODE
+            and cc.node_id in self.members.observers
+            and self.members.observers[cc.node_id] == cc.address
+        )
+
+    def is_invalid_observer_promotion(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type == ConfigChangeType.ADD_NODE
+            and cc.node_id in self.members.observers
+            and self.members.observers[cc.node_id] != cc.address
+        )
+
+    def is_add_existing_member(self, cc: ConfigChange) -> bool:
+        if self.is_promote_observer(cc):
+            return False
+        if cc.type == ConfigChangeType.ADD_NODE:
+            if cc.node_id in self.members.addresses:
+                return True
+        elif cc.type == ConfigChangeType.ADD_OBSERVER:
+            if cc.node_id in self.members.observers:
+                return True
+        elif cc.type == ConfigChangeType.ADD_WITNESS:
+            if cc.node_id in self.members.witnesses:
+                return True
+        else:
+            return False
+        # address reuse by a different node id is also illegal
+        return self._address_in_use(cc.address, cc.node_id)
+
+    def is_add_node_as_observer(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type == ConfigChangeType.ADD_OBSERVER
+            and cc.node_id in self.members.addresses
+        )
+
+    def is_add_node_as_witness(self, cc: ConfigChange) -> bool:
+        return cc.type == ConfigChangeType.ADD_WITNESS and (
+            cc.node_id in self.members.addresses
+            or cc.node_id in self.members.observers
+        )
+
+    def is_deleting_only_node(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type == ConfigChangeType.REMOVE_NODE
+            and len(self.members.addresses) == 1
+            and cc.node_id in self.members.addresses
+        )
+
+    def _address_in_use(self, address: str, node_id: int) -> bool:
+        for nid, addr in self.members.addresses.items():
+            if nid != node_id and addr == address:
+                return True
+        for nid, addr in self.members.observers.items():
+            if nid != node_id and addr == address:
+                return True
+        for nid, addr in self.members.witnesses.items():
+            if nid != node_id and addr == address:
+                return True
+        return False
+
+    def handle_config_change(self, cc: ConfigChange, index: int) -> bool:
+        """Validate + apply; returns whether the change was accepted
+        (cf. membership.go:299+ handleConfigChange)."""
+        accepted = (
+            self.is_conf_change_up_to_date(cc)
+            and not self.is_add_removed_node(cc)
+            and not self.is_add_existing_member(cc)
+            and not self.is_invalid_observer_promotion(cc)
+            and not self.is_add_node_as_observer(cc)
+            and not self.is_add_node_as_witness(cc)
+            and not self.is_deleting_only_node(cc)
+        )
+        if accepted:
+            self._apply(cc, index)
+        return accepted
+
+    def _apply(self, cc: ConfigChange, index: int) -> None:
+        # cf. membership.go:264-298 applyConfigChange; the entry index becomes
+        # the new config change id
+        m = self.members
+        m.config_change_id = index
+        if cc.type == ConfigChangeType.ADD_NODE:
+            m.observers.pop(cc.node_id, None)
+            if cc.node_id in m.witnesses:
+                raise RuntimeError("promoting a witness is not allowed")
+            m.addresses[cc.node_id] = cc.address
+        elif cc.type == ConfigChangeType.ADD_OBSERVER:
+            if cc.node_id in m.addresses:
+                raise RuntimeError("adding an existing member as observer")
+            m.observers[cc.node_id] = cc.address
+        elif cc.type == ConfigChangeType.ADD_WITNESS:
+            if cc.node_id in m.addresses or cc.node_id in m.observers:
+                raise RuntimeError("adding an existing member as witness")
+            m.witnesses[cc.node_id] = cc.address
+        elif cc.type == ConfigChangeType.REMOVE_NODE:
+            m.addresses.pop(cc.node_id, None)
+            m.observers.pop(cc.node_id, None)
+            m.witnesses.pop(cc.node_id, None)
+            m.removed[cc.node_id] = True
+        else:
+            raise RuntimeError(f"unknown config change type {cc.type}")
+
+
+__all__ = ["MembershipManager"]

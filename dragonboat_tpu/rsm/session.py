@@ -1,0 +1,144 @@
+"""Client sessions applied inside the state machine.
+
+At-most-once semantics from the Raft thesis §6.3: each registered client
+session caches the Result of every applied (series_id) until the client
+acknowledges it via responded_to; a retried proposal returns the cached
+Result instead of re-applying (cf. internal/rsm/session.go:48-165,
+sessionmanager.go:25-133, lrusession.go:53-204).
+
+The session image is part of replicated state: it is saved into snapshots
+and must hash identically across replicas.
+"""
+from __future__ import annotations
+
+import struct
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..settings import hard
+from ..statemachine import Result
+
+
+class Session:
+    """Per-client cache of applied-but-unacknowledged results
+    (cf. internal/rsm/session.go:48-165)."""
+
+    __slots__ = ("client_id", "responded_up_to", "history")
+
+    def __init__(self, client_id: int) -> None:
+        self.client_id = client_id
+        self.responded_up_to = 0
+        self.history: Dict[int, Result] = {}
+
+    def add_response(self, series_id: int, result: Result) -> None:
+        if series_id in self.history:
+            raise RuntimeError("adding a duplicated response")
+        self.history[series_id] = result
+
+    def get_response(self, series_id: int) -> Tuple[Optional[Result], bool]:
+        if series_id in self.history:
+            return self.history[series_id], True
+        return None, False
+
+    def has_responded(self, series_id: int) -> bool:
+        return series_id <= self.responded_up_to
+
+    def clear_to(self, series_id: int) -> None:
+        """Client acknowledged everything <= series_id; evict cached results
+        (cf. session.go clearTo)."""
+        if series_id <= self.responded_up_to:
+            return
+        if series_id == self.responded_up_to + 1 and series_id in self.history:
+            del self.history[series_id]
+            self.responded_up_to = series_id
+            return
+        for k in [k for k in self.history if k <= series_id]:
+            del self.history[k]
+        self.responded_up_to = series_id
+
+    # -- snapshot codec ------------------------------------------------------
+    def save(self) -> bytes:
+        items = sorted(self.history.items())
+        parts = [struct.pack("<QQI", self.client_id, self.responded_up_to, len(items))]
+        for sid, res in items:
+            parts.append(struct.pack("<QQI", sid, res.value, len(res.data)))
+            parts.append(res.data)
+        return b"".join(parts)
+
+    @staticmethod
+    def load(data: bytes, off: int = 0) -> Tuple["Session", int]:
+        cid, responded, n = struct.unpack_from("<QQI", data, off)
+        off += 20
+        s = Session(cid)
+        s.responded_up_to = responded
+        for _ in range(n):
+            sid, val, dlen = struct.unpack_from("<QQI", data, off)
+            off += 20
+            s.history[sid] = Result(value=val, data=bytes(data[off : off + dlen]))
+            off += dlen
+        return s, off
+
+
+class SessionManager:
+    """LRU of client sessions, deterministic across replicas: eviction order
+    is a pure function of the applied entry sequence (cf. lrusession.go —
+    the reference uses an llrb-backed LRU; an ordered dict gives the same
+    deterministic recency order)."""
+
+    def __init__(self, max_sessions: Optional[int] = None) -> None:
+        self._max = max_sessions or hard.lru_max_session_count
+        self._lru: "OrderedDict[int, Session]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def register_client_id(self, client_id: int) -> Result:
+        """Apply a session-register entry (cf. sessionmanager.go:49-60)."""
+        if client_id in self._lru:
+            self._lru.move_to_end(client_id)
+            return Result(value=client_id)
+        self._lru[client_id] = Session(client_id)
+        if len(self._lru) > self._max:
+            self._lru.popitem(last=False)
+        return Result(value=client_id)
+
+    def unregister_client_id(self, client_id: int) -> Result:
+        if client_id not in self._lru:
+            return Result(value=0)
+        del self._lru[client_id]
+        return Result(value=client_id)
+
+    def get_registered_client(self, client_id: int) -> Optional[Session]:
+        s = self._lru.get(client_id)
+        if s is not None:
+            self._lru.move_to_end(client_id)
+        return s
+
+    def add_response(self, s: Session, series_id: int, result: Result) -> None:
+        s.add_response(series_id, result)
+
+    # -- snapshot ------------------------------------------------------------
+    def save(self) -> bytes:
+        parts = [struct.pack("<I", len(self._lru))]
+        # LRU order (oldest first) so load() reconstructs identical recency
+        for cid, s in self._lru.items():
+            parts.append(s.save())
+        return b"".join(parts)
+
+    def load(self, data: bytes) -> None:
+        (n,) = struct.unpack_from("<I", data, 0)
+        off = 4
+        self._lru.clear()
+        for _ in range(n):
+            s, off = Session.load(data, off)
+            self._lru[s.client_id] = s
+
+    def hash(self) -> int:
+        """Deterministic digest for cross-replica equality checks
+        (cf. monkey.go GetSessionHash)."""
+        import zlib
+
+        return zlib.crc32(self.save())
+
+
+__all__ = ["Session", "SessionManager"]

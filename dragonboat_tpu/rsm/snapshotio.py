@@ -1,0 +1,240 @@
+"""Versioned, checksummed snapshot file format.
+
+Equivalent of internal/rsm/snapshotio.go + rw.go: a snapshot is a header
+(index/term/membership/sessions metadata) followed by the session image and
+the SM payload written as CRC32-framed blocks, so a truncated or corrupted
+file is always detected before recovery (cf. snapshotio.go:156-368,
+rw.go:113-530 — the v2 block-checksum design; v1's whole-file hash is not
+carried over).
+
+Layout (little-endian):
+    magic      8B  b"DBTPUSS1"
+    version    u32 (=1)
+    header_len u32
+    header     header_len bytes (codec: index/term/on_disk_index/smtype/
+               witness/dummy flags + membership)
+    header_crc u32
+    session    u64 len + bytes + u32 crc
+    payload    blocks of [u32 len][bytes][u32 crc], terminated by len=0,
+               then u64 total_payload_len + u32 crc32-of-crcs
+The same byte stream is used on disk and on the wire (chunked streaming).
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import BinaryIO, List, Optional, Tuple
+
+from .. import codec
+from ..types import Membership
+
+MAGIC = b"DBTPUSS1"
+VERSION = 1
+BLOCK_SIZE = 1024 * 1024
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class SnapshotCorrupted(Exception):
+    pass
+
+
+@dataclass
+class SnapshotHeader:
+    index: int = 0
+    term: int = 0
+    on_disk_index: int = 0
+    smtype: int = 0
+    witness: bool = False
+    dummy: bool = False
+    compression: int = 0
+    membership: Optional[Membership] = None
+
+    def encode(self) -> bytes:
+        parts = [
+            struct.pack(
+                "<QQQIBBB",
+                self.index,
+                self.term,
+                self.on_disk_index,
+                self.smtype,
+                1 if self.witness else 0,
+                1 if self.dummy else 0,
+                self.compression,
+            )
+        ]
+        if self.membership is not None:
+            parts.append(b"\x01" + codec.encode_membership(self.membership))
+        else:
+            parts.append(b"\x00")
+        return b"".join(parts)
+
+    @staticmethod
+    def decode(buf: bytes) -> "SnapshotHeader":
+        index, term, odi, smtype, wit, dummy, comp = struct.unpack_from(
+            "<QQQIBBB", buf, 0
+        )
+        off = 31
+        h = SnapshotHeader(
+            index=index,
+            term=term,
+            on_disk_index=odi,
+            smtype=smtype,
+            witness=bool(wit),
+            dummy=bool(dummy),
+            compression=comp,
+        )
+        if buf[off] == 1:
+            h.membership, _ = codec.decode_membership(buf, off + 1)
+        return h
+
+
+class SnapshotWriter:
+    """Streams the snapshot format to any file-like sink; payload written
+    through write() is block-framed transparently."""
+
+    def __init__(self, f: BinaryIO, header: SnapshotHeader, session: bytes) -> None:
+        self._f = f
+        self._buf = bytearray()
+        self._payload_len = 0
+        self._crc_of_crcs = zlib.crc32(b"")
+        hdr = header.encode()
+        f.write(MAGIC)
+        f.write(_U32.pack(VERSION))
+        f.write(_U32.pack(len(hdr)))
+        f.write(hdr)
+        f.write(_U32.pack(zlib.crc32(hdr)))
+        f.write(_U64.pack(len(session)))
+        f.write(session)
+        f.write(_U32.pack(zlib.crc32(session)))
+
+    def write(self, data: bytes) -> int:
+        self._buf.extend(data)
+        while len(self._buf) >= BLOCK_SIZE:
+            self._flush_block(self._buf[:BLOCK_SIZE])
+            del self._buf[:BLOCK_SIZE]
+        return len(data)
+
+    def _flush_block(self, block) -> None:
+        block = bytes(block)
+        crc = zlib.crc32(block)
+        self._f.write(_U32.pack(len(block)))
+        self._f.write(block)
+        self._f.write(_U32.pack(crc))
+        self._payload_len += len(block)
+        self._crc_of_crcs = zlib.crc32(_U32.pack(crc), self._crc_of_crcs)
+
+    def close(self) -> None:
+        if self._buf:
+            self._flush_block(self._buf)
+            self._buf.clear()
+        self._f.write(_U32.pack(0))  # terminator
+        self._f.write(_U64.pack(self._payload_len))
+        self._f.write(_U32.pack(self._crc_of_crcs & 0xFFFFFFFF))
+
+    # context manager sugar
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.close()
+
+
+class SnapshotReader:
+    """Validating reader over the snapshot format."""
+
+    def __init__(self, f: BinaryIO) -> None:
+        self._f = f
+        magic = f.read(8)
+        if magic != MAGIC:
+            raise SnapshotCorrupted(f"bad magic {magic!r}")
+        (ver,) = _U32.unpack(f.read(4))
+        if ver != VERSION:
+            raise SnapshotCorrupted(f"unsupported version {ver}")
+        (hlen,) = _U32.unpack(f.read(4))
+        hdr = f.read(hlen)
+        (hcrc,) = _U32.unpack(f.read(4))
+        if zlib.crc32(hdr) != hcrc:
+            raise SnapshotCorrupted("header crc mismatch")
+        self.header = SnapshotHeader.decode(hdr)
+        (slen,) = _U64.unpack(f.read(8))
+        self.session = f.read(slen)
+        (scrc,) = _U32.unpack(f.read(4))
+        if zlib.crc32(self.session) != scrc:
+            raise SnapshotCorrupted("session crc mismatch")
+        self._payload_done = False
+        self._pending = b""
+
+    def read(self, n: int = -1) -> bytes:
+        """Read validated payload bytes."""
+        out = bytearray()
+        while n < 0 or len(out) < n:
+            if self._pending:
+                take = len(self._pending) if n < 0 else n - len(out)
+                out.extend(self._pending[:take])
+                self._pending = self._pending[take:]
+                continue
+            if self._payload_done:
+                break
+            (blen,) = _U32.unpack(self._f.read(4))
+            if blen == 0:
+                self._payload_done = True
+                break
+            block = self._f.read(blen)
+            (crc,) = _U32.unpack(self._f.read(4))
+            if len(block) != blen or zlib.crc32(block) != crc:
+                raise SnapshotCorrupted("payload block crc mismatch")
+            self._pending = block
+        return bytes(out)
+
+
+def validate_snapshot_file(path: str) -> bool:
+    """Full-scan validation (cf. SnapshotValidator snapshotio.go:386-435)."""
+    try:
+        with open(path, "rb") as f:
+            r = SnapshotReader(f)
+            while True:
+                chunk = r.read(BLOCK_SIZE)
+                if not chunk:
+                    break
+        return True
+    except (SnapshotCorrupted, struct.error, OSError):
+        return False
+
+
+class StreamValidator:
+    """Incremental validator for chunked snapshot reassembly: feed raw bytes
+    in arrival order; valid() only after the full stream checks out."""
+
+    def __init__(self) -> None:
+        self._buf = io.BytesIO()
+
+    def feed(self, data: bytes) -> None:
+        self._buf.write(data)
+
+    def valid(self) -> bool:
+        self._buf.seek(0)
+        try:
+            r = SnapshotReader(self._buf)
+            while r.read(BLOCK_SIZE):
+                pass
+            return True
+        except (SnapshotCorrupted, struct.error):
+            return False
+        finally:
+            self._buf.seek(0, io.SEEK_END)
+
+
+__all__ = [
+    "SnapshotHeader",
+    "SnapshotWriter",
+    "SnapshotReader",
+    "SnapshotCorrupted",
+    "StreamValidator",
+    "validate_snapshot_file",
+    "BLOCK_SIZE",
+]

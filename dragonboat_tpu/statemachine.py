@@ -1,0 +1,231 @@
+"""User state machine contracts.
+
+This is the equivalent of the reference's `statemachine/` package: the three
+state machine types users implement (cf. statemachine/rsm.go:184-275 for
+IStateMachine, statemachine/concurrent.go:45 for IConcurrentStateMachine,
+statemachine/disk.go:60 for IOnDiskStateMachine), plus the snapshot file
+collection (statemachine/files.go) and sentinel errors.
+
+TPU note: user state machines run host-side, exactly as in the reference —
+the device kernel advances protocol state only. A state machine whose update
+function is itself a JAX computation (e.g. a replicated learner state) can
+batch its applies; see rsm/ for the batched apply path.
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import BinaryIO, List, Optional, Sequence, Tuple
+
+# State machine type discriminators persisted in the bootstrap record
+# (cf. internal/rsm StateMachineType).
+SM_TYPE_UNKNOWN = 0
+SM_TYPE_REGULAR = 1
+SM_TYPE_CONCURRENT = 2
+SM_TYPE_ONDISK = 3
+
+
+class SnapshotStopped(Exception):
+    """Raised inside save/recover when the node is being closed
+    (cf. statemachine/rsm.go ErrSnapshotStopped)."""
+
+
+class SnapshotStreamStopped(Exception):
+    """The snapshot stream was aborted by the receiver."""
+
+
+@dataclass(slots=True)
+class Result:
+    """Outcome of IStateMachine.update (cf. statemachine/rsm.go Result)."""
+
+    value: int = 0
+    data: bytes = b""
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Result)
+            and self.value == other.value
+            and self.data == other.data
+        )
+
+
+@dataclass(slots=True)
+class SnapshotFile:
+    """An external file included in a snapshot (cf. statemachine/files.go)."""
+
+    file_id: int = 0
+    filepath: str = ""
+    metadata: bytes = b""
+
+
+class ISnapshotFileCollection(abc.ABC):
+    """Collection the SM adds external files to during save
+    (cf. statemachine/rsm.go ISnapshotFileCollection)."""
+
+    @abc.abstractmethod
+    def add_file(self, file_id: int, path: str, metadata: bytes) -> None: ...
+
+
+class IStateMachine(abc.ABC):
+    """The regular (mutex-serialized) in-memory state machine
+    (cf. statemachine/rsm.go:184-275). All methods are invoked from the
+    managed-SM layer; update/lookup never run concurrently."""
+
+    @abc.abstractmethod
+    def update(self, data: bytes) -> Result:
+        """Apply one committed proposal; returns the Result delivered to the
+        proposing client (at-most-once under a client session)."""
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object:
+        """Local read against the current state; only invoked after
+        linearizability is established by ReadIndex."""
+
+    @abc.abstractmethod
+    def save_snapshot(
+        self,
+        w: BinaryIO,
+        files: ISnapshotFileCollection,
+        done: "AbortSignal",
+    ) -> None:
+        """Serialize the full state to w."""
+
+    @abc.abstractmethod
+    def recover_from_snapshot(
+        self, r: BinaryIO, files: List[SnapshotFile], done: "AbortSignal"
+    ) -> None:
+        """Rebuild state from a snapshot previously written by
+        save_snapshot."""
+
+    def close(self) -> None:  # optional
+        return None
+
+
+class IConcurrentStateMachine(abc.ABC):
+    """Concurrent-access SM: update(batch) runs serialized with other
+    updates, but snapshotting runs concurrently with updates between
+    prepare_snapshot and save_snapshot (cf. statemachine/concurrent.go:45)."""
+
+    @abc.abstractmethod
+    def update(self, entries: List["SMEntry"]) -> List["SMEntry"]: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    @abc.abstractmethod
+    def prepare_snapshot(self) -> object:
+        """Capture a point-in-time identifier of the state; cheap, runs
+        serialized with update."""
+
+    @abc.abstractmethod
+    def save_snapshot(
+        self,
+        ctx: object,
+        w: BinaryIO,
+        files: ISnapshotFileCollection,
+        done: "AbortSignal",
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def recover_from_snapshot(
+        self, r: BinaryIO, files: List[SnapshotFile], done: "AbortSignal"
+    ) -> None: ...
+
+    def close(self) -> None:
+        return None
+
+
+class IOnDiskStateMachine(abc.ABC):
+    """State machine that persists its own state to disk and survives
+    restarts without full snapshot replay (cf. statemachine/disk.go:60)."""
+
+    @abc.abstractmethod
+    def open(self, stopc: "AbortSignal") -> int:
+        """Open existing state; returns the index of the last applied
+        entry."""
+
+    @abc.abstractmethod
+    def update(self, entries: List["SMEntry"]) -> List["SMEntry"]: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    @abc.abstractmethod
+    def sync(self) -> None:
+        """fsync all in-flight application state."""
+
+    @abc.abstractmethod
+    def prepare_snapshot(self) -> object: ...
+
+    @abc.abstractmethod
+    def save_snapshot(self, ctx: object, w: BinaryIO, done: "AbortSignal") -> None:
+        """Stream the point-in-time state captured by prepare_snapshot; used
+        only for streaming to lagging/new peers."""
+
+    @abc.abstractmethod
+    def recover_from_snapshot(self, r: BinaryIO, done: "AbortSignal") -> None: ...
+
+    def close(self) -> None:
+        return None
+
+
+@dataclass(slots=True)
+class SMEntry:
+    """A committed entry handed to concurrent/on-disk SM update batches
+    (cf. statemachine/rsm.go Entry)."""
+
+    index: int = 0
+    cmd: bytes = b""
+    result: Result = field(default_factory=Result)
+
+
+class AbortSignal:
+    """Cooperative cancellation handle passed into snapshot operations; the
+    reference models this as a <-chan struct{} (statemachine/rsm.go:248)."""
+
+    __slots__ = ("_stopped",)
+
+    def __init__(self) -> None:
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def check(self) -> None:
+        """Raise SnapshotStopped if aborted; SMs call this periodically in
+        long save/recover loops."""
+        if self._stopped:
+            raise SnapshotStopped()
+
+
+def sm_type_of(sm: object) -> int:
+    if isinstance(sm, IOnDiskStateMachine):
+        return SM_TYPE_ONDISK
+    if isinstance(sm, IConcurrentStateMachine):
+        return SM_TYPE_CONCURRENT
+    if isinstance(sm, IStateMachine):
+        return SM_TYPE_REGULAR
+    return SM_TYPE_UNKNOWN
+
+
+__all__ = [
+    "SM_TYPE_UNKNOWN",
+    "SM_TYPE_REGULAR",
+    "SM_TYPE_CONCURRENT",
+    "SM_TYPE_ONDISK",
+    "SnapshotStopped",
+    "SnapshotStreamStopped",
+    "Result",
+    "SnapshotFile",
+    "ISnapshotFileCollection",
+    "IStateMachine",
+    "IConcurrentStateMachine",
+    "IOnDiskStateMachine",
+    "SMEntry",
+    "AbortSignal",
+    "sm_type_of",
+]

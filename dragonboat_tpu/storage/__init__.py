@@ -1,0 +1,14 @@
+"""Log storage layer (cf. internal/logdb/)."""
+
+from .kv import IKVStore, MemKV, WalKV, WriteBatch
+from .logdb import ShardedLogDB
+from .logreader import LogReader
+
+__all__ = [
+    "IKVStore",
+    "MemKV",
+    "WalKV",
+    "WriteBatch",
+    "ShardedLogDB",
+    "LogReader",
+]

@@ -1,0 +1,83 @@
+"""LogDB key schema.
+
+Big-endian fixed-width keys so lexicographic order equals numeric order
+(cf. internal/logdb/pooledkey.go:44-176 — the reference's key spaces for
+entries, state, maxIndex, bootstrap, and snapshots are kept, minus pooling:
+CPython small-bytes churn is cheap relative to the fsync-dominated path).
+"""
+from __future__ import annotations
+
+import struct
+
+_EKEY = struct.Struct(">cQQQ")  # 'e', cluster, node, index
+_NKEY = struct.Struct(">cQQ")  # prefix, cluster, node
+_SKEY = struct.Struct(">cQQQ")  # 'p', cluster, node, index
+
+ENTRY = b"e"
+STATE = b"s"
+MAX_INDEX = b"m"
+BOOTSTRAP = b"b"
+SNAPSHOT = b"p"
+
+
+def entry_key(cluster_id: int, node_id: int, index: int) -> bytes:
+    return _EKEY.pack(ENTRY, cluster_id, node_id, index)
+
+
+def entry_range(cluster_id: int, node_id: int, low: int, high: int):
+    """[low, high) iteration bounds."""
+    return (
+        _EKEY.pack(ENTRY, cluster_id, node_id, low),
+        _EKEY.pack(ENTRY, cluster_id, node_id, high),
+    )
+
+
+def entry_index(key: bytes) -> int:
+    return _EKEY.unpack(key)[3]
+
+
+def state_key(cluster_id: int, node_id: int) -> bytes:
+    return _NKEY.pack(STATE, cluster_id, node_id)
+
+
+def max_index_key(cluster_id: int, node_id: int) -> bytes:
+    return _NKEY.pack(MAX_INDEX, cluster_id, node_id)
+
+
+def bootstrap_key(cluster_id: int, node_id: int) -> bytes:
+    return _NKEY.pack(BOOTSTRAP, cluster_id, node_id)
+
+
+def bootstrap_prefix() -> bytes:
+    return BOOTSTRAP
+
+
+def snapshot_key(cluster_id: int, node_id: int, index: int) -> bytes:
+    return _SKEY.pack(SNAPSHOT, cluster_id, node_id, index)
+
+
+def snapshot_range(cluster_id: int, node_id: int, low: int, high: int):
+    return (
+        _SKEY.pack(SNAPSHOT, cluster_id, node_id, low),
+        _SKEY.pack(SNAPSHOT, cluster_id, node_id, high),
+    )
+
+
+def parse_node_key(key: bytes):
+    """(cluster_id, node_id) from a state/bootstrap/maxindex key."""
+    _, cid, nid = _NKEY.unpack(key)
+    return cid, nid
+
+
+__all__ = [
+    "entry_key",
+    "entry_range",
+    "entry_index",
+    "state_key",
+    "max_index_key",
+    "bootstrap_key",
+    "bootstrap_prefix",
+    "snapshot_key",
+    "snapshot_range",
+    "parse_node_key",
+]

@@ -1,0 +1,275 @@
+"""Minimal ordered-KV contract + implementations backing the LogDB.
+
+The reference's LogDB sits on a pluggable IKVStore (RocksDB/LevelDB/Pebble,
+cf. internal/logdb/kv/kv.go:28-74). Here the contract is the same shape —
+ordered iteration, atomic write batches, range deletes, compaction — with
+two built-in stores:
+
+  - MemKV: in-process ordered dict (tests, benchmarks, loopback slices)
+  - WalKV: durable append-only WAL + in-memory table; write batches are
+    appended and fsynced as one record group, compaction rewrites the live
+    table to a fresh file with atomic rename (crash-safe: a torn tail
+    record is detected by CRC and discarded on replay)
+
+Keys are bytes and compare lexicographically; the key schema (keys.py) uses
+big-endian ids so numeric order == byte order.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+_REC = struct.Struct("<IBII")  # total_len, op, klen, vlen
+_OP_PUT = 0
+_OP_DEL = 1
+_OP_RANGE_DEL = 2
+
+
+class WriteBatch:
+    """Ordered list of mutations applied atomically
+    (cf. kv.go IWriteBatch)."""
+
+    __slots__ = ("ops",)
+
+    def __init__(self) -> None:
+        self.ops: List[Tuple[int, bytes, bytes]] = []
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.ops.append((_OP_PUT, key, value))
+
+    def delete(self, key: bytes) -> None:
+        self.ops.append((_OP_DEL, key, b""))
+
+    def delete_range(self, start: bytes, end: bytes) -> None:
+        self.ops.append((_OP_RANGE_DEL, start, end))
+
+    def clear(self) -> None:
+        self.ops.clear()
+
+    def count(self) -> int:
+        return len(self.ops)
+
+
+class IKVStore:
+    """cf. internal/logdb/kv/kv.go:28-74."""
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def get_value(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put_value(self, key: bytes, value: bytes) -> None:
+        wb = WriteBatch()
+        wb.put(key, value)
+        self.commit_write_batch(wb)
+
+    def delete_value(self, key: bytes) -> None:
+        wb = WriteBatch()
+        wb.delete(key)
+        self.commit_write_batch(wb)
+
+    def iterate_value(
+        self,
+        fk: bytes,
+        lk: bytes,
+        inc_last: bool,
+        op: Callable[[bytes, bytes], bool],
+    ) -> None:
+        """Visit keys in [fk, lk) or [fk, lk] in order; op returns False to
+        stop."""
+        raise NotImplementedError
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        raise NotImplementedError
+
+    def bulk_remove_entries(self, fk: bytes, lk: bytes) -> None:
+        """Range delete [fk, lk)."""
+        raise NotImplementedError
+
+    def compact_entries(self, fk: bytes, lk: bytes) -> None:
+        """Reclaim space for a removed range; may be a no-op."""
+        return None
+
+    def full_compaction(self) -> None:
+        return None
+
+
+class MemKV(IKVStore):
+    """Ordered in-memory store: dict + lazily sorted key list."""
+
+    def __init__(self) -> None:
+        self._d: Dict[bytes, bytes] = {}
+        self._sorted: Optional[List[bytes]] = None
+        self._mu = threading.RLock()
+
+    def name(self) -> str:
+        return "memkv"
+
+    def close(self) -> None:
+        pass
+
+    def _keys(self) -> List[bytes]:
+        if self._sorted is None:
+            self._sorted = sorted(self._d)
+        return self._sorted
+
+    def get_value(self, key: bytes) -> Optional[bytes]:
+        with self._mu:
+            return self._d.get(key)
+
+    def iterate_value(self, fk, lk, inc_last, op) -> None:
+        import bisect
+
+        with self._mu:
+            keys = self._keys()
+            i = bisect.bisect_left(keys, fk)
+            while i < len(keys):
+                k = keys[i]
+                if (inc_last and k > lk) or (not inc_last and k >= lk):
+                    break
+                if not op(k, self._d[k]):
+                    break
+                i += 1
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        with self._mu:
+            for op, k, v in wb.ops:
+                if op == _OP_PUT:
+                    if k not in self._d:
+                        self._sorted = None
+                    self._d[k] = v
+                elif op == _OP_DEL:
+                    if self._d.pop(k, None) is not None:
+                        self._sorted = None
+                else:
+                    self._range_del(k, v)
+
+    def _range_del(self, start: bytes, end: bytes) -> None:
+        dead = [k for k in self._d if start <= k < end]
+        for k in dead:
+            del self._d[k]
+        if dead:
+            self._sorted = None
+
+    def bulk_remove_entries(self, fk, lk) -> None:
+        with self._mu:
+            self._range_del(fk, lk)
+
+
+class WalKV(IKVStore):
+    """Durable WAL-backed store. All reads served from the in-memory table;
+    durability from the fsynced append-only log."""
+
+    def __init__(self, dirname: str, fsync: bool = True) -> None:
+        self._dir = dirname
+        self._fsync = fsync
+        self._mem = MemKV()
+        self._mu = threading.RLock()
+        os.makedirs(dirname, exist_ok=True)
+        self._path = os.path.join(dirname, "wal.log")
+        self._replay()
+        self._f = open(self._path, "ab")
+        self._since_compact = 0
+
+    def name(self) -> str:
+        return "walkv"
+
+    # -- recovery ------------------------------------------------------------
+    def _replay(self) -> None:
+        compacted = os.path.join(self._dir, "table.log")
+        for path in (compacted, self._path):
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            wb = WriteBatch()
+            while off + _REC.size <= len(data):
+                total, op, klen, vlen = _REC.unpack_from(data, off)
+                end = off + _REC.size + klen + vlen + 4
+                if end > len(data):
+                    break  # torn tail
+                k = data[off + _REC.size : off + _REC.size + klen]
+                v = data[off + _REC.size + klen : end - 4]
+                (crc,) = struct.unpack_from("<I", data, end - 4)
+                if zlib.crc32(data[off : end - 4]) != crc:
+                    break  # torn/corrupt tail: stop replay here
+                wb.ops.append((op, bytes(k), bytes(v)))
+                off = end
+            self._mem.commit_write_batch(wb)
+
+    # -- reads ---------------------------------------------------------------
+    def get_value(self, key):
+        return self._mem.get_value(key)
+
+    def iterate_value(self, fk, lk, inc_last, op):
+        self._mem.iterate_value(fk, lk, inc_last, op)
+
+    # -- writes --------------------------------------------------------------
+    def _append_rec(self, op: int, k: bytes, v: bytes) -> None:
+        rec = _REC.pack(_REC.size + len(k) + len(v) + 4, op, len(k), len(v)) + k + v
+        self._f.write(rec + struct.pack("<I", zlib.crc32(rec)))
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        with self._mu:
+            for op, k, v in wb.ops:
+                self._append_rec(op, k, v)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._mem.commit_write_batch(wb)
+            self._since_compact += len(wb.ops)
+
+    def bulk_remove_entries(self, fk, lk) -> None:
+        wb = WriteBatch()
+        wb.delete_range(fk, lk)
+        self.commit_write_batch(wb)
+
+    def compact_entries(self, fk, lk) -> None:
+        with self._mu:
+            if self._since_compact < 100000:
+                return
+            self.full_compaction()
+
+    def full_compaction(self) -> None:
+        """Rewrite the live table into table.log, truncate the WAL
+        (crash-safe via tmp+rename: the WAL is only truncated after the
+        compacted table is durable)."""
+        with self._mu:
+            tmp = os.path.join(self._dir, "table.log.tmp")
+            final = os.path.join(self._dir, "table.log")
+            with open(tmp, "wb") as f:
+                items: List[Tuple[bytes, bytes]] = []
+                self._mem.iterate_value(
+                    b"", b"\xff" * 64, True, lambda k, v: (items.append((k, v)), True)[1]
+                )
+                for k, v in items:
+                    rec = _REC.pack(_REC.size + len(k) + len(v) + 4, _OP_PUT, len(k), len(v)) + k + v
+                    f.write(rec + struct.pack("<I", zlib.crc32(rec)))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+            self._f.close()
+            self._f = open(self._path, "wb")
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._since_compact = 0
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._f.flush()
+                if self._fsync:
+                    os.fsync(self._f.fileno())
+            finally:
+                self._f.close()
+
+
+__all__ = ["IKVStore", "WriteBatch", "MemKV", "WalKV"]

@@ -1,0 +1,175 @@
+"""LogReader: the core-facing read view over the sharded LogDB.
+
+Adapter implementing core.logentry.ILogDB (the raft core's stable-storage
+read contract) on top of raftio.ILogDB — the in-core [marker, marker+length)
+index window plus cached State/Membership/Snapshot, exactly the reference's
+LogReader design (cf. internal/logdb/logreader.go:50-290). Entries appended
+by the engine extend the window immediately (set_range) even though the
+fsync may still be in flight on the engine's save path — the raft core only
+reads entry ranges it created itself, so the window is always consistent
+with what will be durable before any dependent message leaves the process.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..core.logentry import ErrCompacted, ErrUnavailable
+from ..raftio import ErrNoSavedLog, ILogDB as RaftIOLogDB
+from ..settings import soft
+from ..types import Entry, Membership, Snapshot, State
+
+
+class LogReader:
+    def __init__(self, cluster_id: int, node_id: int, logdb: RaftIOLogDB) -> None:
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self._db = logdb
+        self._mu = threading.RLock()
+        # window: entries (marker, marker+length) are available; the entry AT
+        # marker is the snapshot/compaction boundary (term known, data gone)
+        self._marker = 0
+        self._marker_term = 0
+        self._length = 1  # reference counts the marker itself
+        self._state = State()
+        self._membership = Membership()
+        self._snapshot = Snapshot()
+
+    # ------------------------------------------------------- core.ILogDB view
+    def node_state(self) -> Tuple[State, Membership]:
+        with self._mu:
+            return self._state, self._membership
+
+    def get_range(self) -> Tuple[int, int]:
+        with self._mu:
+            return self._first_index(), self._last_index()
+
+    def _first_index(self) -> int:
+        return self._marker + 1
+
+    def _last_index(self) -> int:
+        return self._marker + self._length - 1
+
+    def term(self, index: int) -> int:
+        with self._mu:
+            if index == self._marker:
+                return self._marker_term
+            if index < self._marker:
+                raise ErrCompacted()
+            if index > self._last_index():
+                raise ErrUnavailable()
+            ents, _ = self._db.iterate_entries(
+                self.cluster_id, self.node_id, index, index + 1, soft.max_entry_size
+            )
+            if not ents:
+                raise ErrUnavailable()
+            return ents[0].term
+
+    def entries(self, low: int, high: int, max_size: int) -> List[Entry]:
+        with self._mu:
+            if low <= self._marker:
+                raise ErrCompacted()
+            if high > self._last_index() + 1:
+                raise ErrUnavailable()
+            ents, _ = self._db.iterate_entries(
+                self.cluster_id, self.node_id, low, high, max_size
+            )
+            return ents
+
+    def snapshot(self) -> Snapshot:
+        with self._mu:
+            return self._snapshot
+
+    # ------------------------------------------------------------ write hooks
+    def set_state(self, st: State) -> None:
+        with self._mu:
+            self._state = st
+
+    def set_membership(self, m: Membership) -> None:
+        with self._mu:
+            self._membership = m
+
+    def append(self, entries: List[Entry]) -> None:
+        """Extend the window after the engine queues entries for persistence
+        (cf. logreader.go:223-263 Append -> SetRange)."""
+        if not entries:
+            return
+        first = entries[0].index
+        last = entries[-1].index
+        if first + len(entries) - 1 != last:
+            raise RuntimeError("gap in entries")
+        self.set_range(first, len(entries))
+
+    def set_range(self, first: int, length: int) -> None:
+        with self._mu:
+            if length == 0:
+                return
+            last = first + length - 1
+            if last <= self._marker:
+                return  # all compacted away
+            if first <= self._marker:
+                # partial overlap with marker: trim below
+                length -= self._marker - first + 1
+                first = self._marker + 1
+            offset = first - self._marker
+            if self._length > offset:
+                self._length = offset + length
+            elif self._length == offset:
+                self._length += length
+            else:
+                raise RuntimeError(
+                    f"log hole: marker {self._marker} len {self._length} "
+                    f"appending at {first}"
+                )
+
+    def apply_snapshot(self, ss: Snapshot) -> None:
+        """Reset the window to the snapshot point (install path)."""
+        with self._mu:
+            self._snapshot = ss
+            self._marker = ss.index
+            self._marker_term = ss.term
+            self._length = 1
+            if ss.membership is not None:
+                self._membership = ss.membership
+
+    def create_snapshot(self, ss: Snapshot) -> None:
+        """Record a locally created snapshot without moving the window
+        (cf. logreader.go:197-221 CreateSnapshot)."""
+        with self._mu:
+            if ss.index < self._snapshot.index:
+                return
+            self._snapshot = ss
+
+    def compact(self, index: int) -> None:
+        """Move the marker forward, dropping [old_marker, index)
+        (cf. logreader.go:272+ Compact)."""
+        with self._mu:
+            if index <= self._marker:
+                raise ErrCompacted()
+            if index > self._last_index():
+                raise ErrUnavailable()
+            term = self.term(index)
+            i = index - self._marker
+            self._length -= i
+            self._marker = index
+            self._marker_term = term
+
+    # -------------------------------------------------------------- recovery
+    def load(self, snapshot: Optional[Snapshot]) -> None:
+        """Restart path: position the window from the latest snapshot +
+        persisted log range (cf. node.go:553-583 replayLog)."""
+        if snapshot is not None and not snapshot.is_empty():
+            self.apply_snapshot(snapshot)
+        try:
+            rs = self._db.read_raft_state(
+                self.cluster_id, self.node_id, self._marker
+            )
+        except ErrNoSavedLog:
+            return  # fresh node; anything else (corruption/IO) must crash
+        if rs.state is not None:
+            self._state = rs.state
+        if rs.entry_count > 0:
+            self.set_range(rs.first_index, rs.entry_count)
+
+
+__all__ = ["LogReader"]

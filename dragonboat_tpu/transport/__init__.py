@@ -1,0 +1,14 @@
+"""Transport layer (cf. internal/transport/)."""
+
+from .loopback import LoopbackRPC, loopback_factory
+from .nodes import Nodes
+from .tcp import TCPTransport
+from .transport import Transport
+
+__all__ = [
+    "Transport",
+    "Nodes",
+    "TCPTransport",
+    "LoopbackRPC",
+    "loopback_factory",
+]

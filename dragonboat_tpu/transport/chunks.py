@@ -1,0 +1,174 @@
+"""Inbound snapshot chunk reassembly.
+
+cf. internal/transport/chunks.go:67-347 — tracks in-flight snapshot
+streams, writes chunks into a .receiving temp dir, validates the assembled
+file, atomically finalizes it into the node's snapshot directory, and
+converts the completed stream into an InstallSnapshot message delivered
+through the normal receive path.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..rsm.snapshotio import validate_snapshot_file
+from ..types import Message, MessageBatch, MessageType, Snapshot, SnapshotChunk
+from ..settings import soft
+
+
+class _Track:
+    __slots__ = ("first", "next_chunk", "f", "tmp_dir", "final_dir", "files", "tick")
+
+    def __init__(self, first: SnapshotChunk, tmp_dir: str, final_dir: str) -> None:
+        self.first = first
+        self.next_chunk = 1
+        self.tmp_dir = tmp_dir
+        self.final_dir = final_dir
+        self.f = None
+        self.files = []  # (file_info, local_path)
+        self.tick = 0
+
+
+class Chunks:
+    """cf. Chunks internal/transport/chunks.go:67-98."""
+
+    def __init__(self, nodehost) -> None:
+        self._nh = nodehost
+        self._mu = threading.Lock()
+        self._tracked: Dict[Tuple[int, int, int], _Track] = {}
+        self._tick = 0
+
+    def _key(self, c: SnapshotChunk) -> Tuple[int, int, int]:
+        return (c.cluster_id, c.node_id, c.from_)
+
+    # ------------------------------------------------------------------ entry
+    def add_chunk(self, c: SnapshotChunk) -> bool:
+        """Returns False to reject the stream (cf. addChunk
+        chunks.go:227-282)."""
+        with self._mu:
+            key = self._key(c)
+            t = self._tracked.get(key)
+            if c.chunk_id == 0:
+                if t is not None:
+                    self._drop(key)
+                t = self._begin(c)
+                if t is None:
+                    return False
+            elif t is None or c.chunk_id != t.next_chunk:
+                if t is not None:
+                    self._drop(key)
+                return False
+            else:
+                t.next_chunk += 1
+            try:
+                self._save_chunk(t, c)
+            except OSError:
+                self._drop(key)
+                return False
+            if c.chunk_id == c.chunk_count - 1:
+                ok = self._finalize(key, t, c)
+                return ok
+            return True
+
+    # ------------------------------------------------------------------ paths
+    def _node_snapshot_dir(self, cluster_id: int, node_id: int) -> str:
+        return os.path.join(
+            self._nh.snapshot_dir_root(),
+            f"snapshot-part-{cluster_id:020d}-{node_id:020d}",
+        )
+
+    def _begin(self, c: SnapshotChunk) -> Optional[_Track]:
+        base = self._node_snapshot_dir(c.cluster_id, c.node_id)
+        final_dir = os.path.join(base, f"snapshot-{c.index:016X}")
+        tmp_dir = final_dir + ".receiving"
+        if os.path.exists(final_dir):
+            return None  # already have this snapshot
+        os.makedirs(tmp_dir, exist_ok=True)
+        t = _Track(c, tmp_dir, final_dir)
+        t.tick = self._tick
+        self._tracked[self._key(c)] = t
+        return t
+
+    def _save_chunk(self, t: _Track, c: SnapshotChunk) -> None:
+        if c.witness:
+            return
+        if c.has_file_info:
+            name = f"external-file-{c.file_info.file_id}"
+        else:
+            name = f"snapshot-{c.index:016X}.gbsnap"
+        path = os.path.join(t.tmp_dir, name)
+        mode = "wb" if c.file_chunk_id == 0 else "ab"
+        with open(path, mode) as f:
+            f.write(c.data)
+        if c.has_file_info and c.file_chunk_id == c.file_chunk_count - 1:
+            t.files.append((c.file_info, os.path.join(t.final_dir, name)))
+
+    def _finalize(self, key, t: _Track, c: SnapshotChunk) -> bool:
+        first = t.first
+        fname = f"snapshot-{first.index:016X}.gbsnap"
+        fpath = os.path.join(t.tmp_dir, fname)
+        if not first.witness:
+            if not validate_snapshot_file(fpath):
+                self._drop(key)
+                return False
+        del self._tracked[key]
+        if os.path.exists(t.final_dir):
+            shutil.rmtree(t.tmp_dir, ignore_errors=True)
+            return True
+        os.replace(t.tmp_dir, t.final_dir)
+        final_path = os.path.join(t.final_dir, fname)
+        from ..types import SnapshotFile as WireFile
+
+        wire_files = [
+            WireFile(
+                filepath=lp,
+                file_size=os.path.getsize(lp),
+                file_id=fi.file_id,
+                metadata=fi.metadata,
+            )
+            for fi, lp in t.files
+        ]
+        ss = Snapshot(
+            filepath=final_path,
+            file_size=os.path.getsize(final_path) if not first.witness else 0,
+            index=first.index,
+            term=first.term,
+            membership=first.membership,
+            files=wire_files,
+            cluster_id=first.cluster_id,
+            on_disk_index=first.on_disk_index,
+            witness=first.witness,
+        )
+        m = Message(
+            type=MessageType.INSTALL_SNAPSHOT,
+            cluster_id=first.cluster_id,
+            to=first.node_id,
+            from_=first.from_,
+            snapshot=ss,
+        )
+        self._nh.handle_message_batch(MessageBatch(requests=[m]))
+        self._nh.handle_snapshot(first.cluster_id, first.node_id, first.from_)
+        return True
+
+    def _drop(self, key) -> None:
+        t = self._tracked.pop(key, None)
+        if t is not None:
+            shutil.rmtree(t.tmp_dir, ignore_errors=True)
+
+    # --------------------------------------------------------------------- gc
+    def tick(self) -> None:
+        """Periodic timeout sweep (cf. chunks.go:112-139)."""
+        with self._mu:
+            self._tick += 1
+            dead = [
+                k
+                for k, t in self._tracked.items()
+                if self._tick - t.tick > soft.snapshot_chunk_timeout_tick
+            ]
+            for k in dead:
+                self._drop(k)
+
+
+__all__ = ["Chunks"]

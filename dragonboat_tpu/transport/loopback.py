@@ -1,0 +1,145 @@
+"""In-process loopback RPC module for tests and single-process deployments.
+
+The analogue of the reference's NOOP transport
+(cf. internal/transport/noop.go:30-177): message batches are delivered
+directly to the destination's registered handler through a process-global
+registry, with SetToFail/SetBlocked chaos knobs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ..raftio import IConnection, IRaftRPC, ISnapshotConnection
+from ..types import MessageBatch, SnapshotChunk
+from .. import codec
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._handlers: Dict[str, tuple] = {}
+
+    def register(self, addr: str, req_handler, chunk_handler) -> None:
+        with self._mu:
+            self._handlers[addr] = (req_handler, chunk_handler)
+
+    def unregister(self, addr: str) -> None:
+        with self._mu:
+            self._handlers.pop(addr, None)
+
+    def lookup(self, addr: str):
+        with self._mu:
+            return self._handlers.get(addr)
+
+
+_global_registry = _Registry()
+
+
+class LoopbackConnection(IConnection):
+    def __init__(self, rpc: "LoopbackRPC", target: str) -> None:
+        self._rpc = rpc
+        self._target = target
+
+    def close(self) -> None:
+        pass
+
+    def send_message_batch(self, batch: MessageBatch) -> None:
+        self._rpc.deliver(self._target, batch)
+
+
+class LoopbackSnapshotConnection(ISnapshotConnection):
+    def __init__(self, rpc: "LoopbackRPC", target: str) -> None:
+        self._rpc = rpc
+        self._target = target
+
+    def close(self) -> None:
+        pass
+
+    def send_chunk(self, chunk: SnapshotChunk) -> None:
+        self._rpc.deliver_chunk(self._target, chunk)
+
+
+class LoopbackRPC(IRaftRPC):
+    """In-process IRaftRPC; every instance registers its own address and
+    dials others through the shared registry."""
+
+    def __init__(
+        self,
+        request_handler: Callable[[MessageBatch], None],
+        chunk_handler: Callable[[SnapshotChunk], bool],
+        address: str = "",
+        registry: Optional[_Registry] = None,
+    ) -> None:
+        self._address = address
+        self._req_handler = request_handler
+        self._chunk_handler = chunk_handler
+        self._registry = registry or _global_registry
+        # chaos knobs (cf. noop.go SetToFail / SetBlocked)
+        self.fail_send = False
+        self.blocked = False
+
+    def set_address(self, address: str) -> None:
+        self._address = address
+
+    def name(self) -> str:
+        return "loopback"
+
+    def start(self) -> None:
+        self._registry.register(
+            self._address, self._req_handler, self._chunk_handler
+        )
+
+    def stop(self) -> None:
+        self._registry.unregister(self._address)
+
+    def get_connection(self, target: str) -> LoopbackConnection:
+        if self.fail_send or self._registry.lookup(target) is None:
+            raise ConnectionError(f"loopback: no listener at {target}")
+        return LoopbackConnection(self, target)
+
+    def get_snapshot_connection(self, target: str) -> LoopbackSnapshotConnection:
+        if self.fail_send or self._registry.lookup(target) is None:
+            raise ConnectionError(f"loopback: no listener at {target}")
+        return LoopbackSnapshotConnection(self, target)
+
+    def deliver(self, target: str, batch: MessageBatch) -> None:
+        if self.blocked or self.fail_send:
+            raise ConnectionError("loopback send blocked")
+        entry = self._registry.lookup(target)
+        if entry is None:
+            raise ConnectionError(f"loopback: no listener at {target}")
+        # serialize/deserialize to guarantee value semantics across "hosts"
+        # and to exercise the codec exactly like the TCP path does
+        data = codec.encode_message_batch(batch)
+        decoded, _ = codec.decode_message_batch(data)
+        entry[0](decoded)
+
+    def deliver_chunk(self, target: str, chunk: SnapshotChunk) -> None:
+        if self.blocked or self.fail_send:
+            raise ConnectionError("loopback send blocked")
+        entry = self._registry.lookup(target)
+        if entry is None:
+            raise ConnectionError(f"loopback: no listener at {target}")
+        data = codec.encode_chunk(chunk)
+        decoded, _ = codec.decode_chunk(data)
+        if not entry[1](decoded):
+            raise ConnectionError("chunk rejected")
+
+
+def loopback_factory(address: str = "", registry=None):
+    """Factory adapter for Transport(rpc_factory=...)."""
+
+    def make(request_handler, chunk_handler):
+        return LoopbackRPC(
+            request_handler, chunk_handler, address=address, registry=registry
+        )
+
+    return make
+
+
+__all__ = [
+    "LoopbackRPC",
+    "loopback_factory",
+    "_global_registry",
+]

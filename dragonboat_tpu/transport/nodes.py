@@ -1,0 +1,57 @@
+"""Node registry: (cluster_id, node_id) -> NodeHost address resolution.
+
+cf. internal/transport/nodes.go — static records added via add_node plus
+remotes learned from inbound traffic source addresses; reverse resolution
+feeds Unreachable fanout when a target address fails.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class Nodes:
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._addr: Dict[Tuple[int, int], str] = {}
+        self._learned: Dict[Tuple[int, int], str] = {}
+
+    def add_node(self, cluster_id: int, node_id: int, address: str) -> None:
+        with self._mu:
+            self._addr[(cluster_id, node_id)] = address
+
+    def add_remote_address(self, cluster_id: int, node_id: int, address: str) -> None:
+        """Record an address learned from inbound traffic
+        (cf. nodes.go AddRemoteAddress)."""
+        with self._mu:
+            if (cluster_id, node_id) not in self._addr:
+                self._learned[(cluster_id, node_id)] = address
+
+    def resolve(self, cluster_id: int, node_id: int) -> Optional[str]:
+        with self._mu:
+            addr = self._addr.get((cluster_id, node_id))
+            if addr is None:
+                addr = self._learned.get((cluster_id, node_id))
+            return addr
+
+    def reverse_resolve(self, address: str) -> List[Tuple[int, int]]:
+        with self._mu:
+            out = [k for k, v in self._addr.items() if v == address]
+            out.extend(
+                k for k, v in self._learned.items() if v == address and k not in out
+            )
+            return out
+
+    def remove_cluster(self, cluster_id: int) -> None:
+        with self._mu:
+            for d in (self._addr, self._learned):
+                for k in [k for k in d if k[0] == cluster_id]:
+                    del d[k]
+
+    def remove_node(self, cluster_id: int, node_id: int) -> None:
+        with self._mu:
+            self._addr.pop((cluster_id, node_id), None)
+            self._learned.pop((cluster_id, node_id), None)
+
+
+__all__ = ["Nodes"]

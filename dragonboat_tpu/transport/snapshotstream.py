@@ -1,0 +1,138 @@
+"""Outbound snapshot streaming: split a snapshot file into chunks.
+
+cf. internal/transport/snapshot.go:55-110 + 282-291 — an InstallSnapshot
+message is materialized as a sequence of SnapshotChunks (2MB default):
+chunk 0 carries the membership + metadata, the last chunk completes the
+file; external files follow the main payload, each tagged with
+file_chunk_id/file_info.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+from ..settings import soft
+from ..types import Message, Snapshot, SnapshotChunk
+
+
+def split_snapshot_message(m: Message, chunk_size: int = 0) -> List[SnapshotChunk]:
+    """Plan the chunk sequence for a snapshot message (data filled lazily at
+    send time, cf. snapshot.go:282-291)."""
+    ss = m.snapshot
+    chunk_size = chunk_size or soft.sent_snapshot_chunk_size
+    chunks: List[SnapshotChunk] = []
+    main_chunks = max(1, -(-max(ss.file_size, 1) // chunk_size))
+    total = main_chunks + sum(
+        max(1, -(-max(f.file_size, 1) // chunk_size)) for f in ss.files
+    )
+    cid = 0
+    for i in range(main_chunks):
+        chunks.append(
+            SnapshotChunk(
+                cluster_id=m.cluster_id,
+                node_id=m.to,
+                from_=m.from_,
+                chunk_id=cid,
+                chunk_count=total,
+                index=ss.index,
+                term=ss.term,
+                filepath=ss.filepath,
+                file_size=ss.file_size,
+                file_chunk_id=i,
+                file_chunk_count=main_chunks,
+                membership=ss.membership if cid == 0 else None,
+                on_disk_index=ss.on_disk_index,
+                witness=ss.witness,
+            )
+        )
+        cid += 1
+    for f in ss.files:
+        f_chunks = max(1, -(-max(f.file_size, 1) // chunk_size))
+        for i in range(f_chunks):
+            chunks.append(
+                SnapshotChunk(
+                    cluster_id=m.cluster_id,
+                    node_id=m.to,
+                    from_=m.from_,
+                    chunk_id=cid,
+                    chunk_count=total,
+                    index=ss.index,
+                    term=ss.term,
+                    filepath=f.filepath,
+                    file_size=f.file_size,
+                    file_chunk_id=i,
+                    file_chunk_count=f_chunks,
+                    has_file_info=True,
+                    file_info=f,
+                    on_disk_index=ss.on_disk_index,
+                    witness=ss.witness,
+                )
+            )
+            cid += 1
+    return chunks
+
+
+def load_chunk_data(chunk: SnapshotChunk, chunk_size: int = 0) -> SnapshotChunk:
+    chunk_size = chunk_size or soft.sent_snapshot_chunk_size
+    offset = chunk.file_chunk_id * chunk_size
+    with open(chunk.filepath, "rb") as f:
+        f.seek(offset)
+        chunk.data = f.read(chunk_size)
+    chunk.chunk_size = len(chunk.data)
+    return chunk
+
+
+class SnapshotLane:
+    """One in-flight outbound snapshot stream (cf. lane.go:40-237); runs on
+    its own thread, reports success/failure back to the leader's raft."""
+
+    def __init__(
+        self,
+        transport,
+        target_addr: str,
+        m: Message,
+        on_done: Callable[[int, int, bool], None],
+        max_concurrent: Optional[threading.Semaphore] = None,
+    ) -> None:
+        self._transport = transport
+        self._target = target_addr
+        self._m = m
+        self._on_done = on_done
+        self._sem = max_concurrent
+        self.thread = threading.Thread(
+            target=self._run, name="snapshot-lane", daemon=True
+        )
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def _run(self) -> None:
+        if self._sem is not None and not self._sem.acquire(timeout=60):
+            self._on_done(self._m.cluster_id, self._m.to, True)
+            return
+        failed = False
+        conn = None
+        try:
+            conn = self._transport.rpc.get_snapshot_connection(self._target)
+            for chunk in split_snapshot_message(self._m):
+                if not self._m.snapshot.witness:
+                    chunk = load_chunk_data(chunk)
+                conn.send_chunk(chunk)
+        except Exception:
+            failed = True
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            if self._sem is not None:
+                self._sem.release()
+            # failure feeds SnapshotStatus back into the sender's raft;
+            # success waits for the receiver's SnapshotReceived ack
+            if failed:
+                self._on_done(self._m.cluster_id, self._m.to, True)
+
+
+__all__ = ["split_snapshot_message", "load_chunk_data", "SnapshotLane"]

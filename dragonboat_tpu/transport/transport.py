@@ -1,0 +1,269 @@
+"""Transport orchestrator: per-target async send queues with batching and
+circuit breakers.
+
+cf. internal/transport/transport.go:188-557 — each remote NodeHost address
+gets a lazily created queue + worker; the worker drains the queue into
+MessageBatches (bounded bytes per batch), reconnecting through the pluggable
+IRaftRPC. Send failures trip a per-target breaker and fan out Unreachable
+notifications to every (cluster, node) resolving to that address.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..raftio import IMessageHandler, IRaftRPC
+from ..settings import soft
+from ..types import Message, MessageBatch, MessageType
+from .nodes import Nodes
+
+BIN_VER = 1
+
+
+class _Breaker:
+    """Minimal circuit breaker (cf. netutil/circuitbreaker usage
+    transport.go:299-311): opens after consecutive failures, half-opens
+    after a cooldown."""
+
+    def __init__(self, threshold: int = 1, cooldown: float = 1.0) -> None:
+        self._threshold = threshold
+        self._cooldown = cooldown
+        self._fails = 0
+        self._opened_at = 0.0
+        self._mu = threading.Lock()
+
+    def ready(self) -> bool:
+        with self._mu:
+            if self._fails < self._threshold:
+                return True
+            return time.monotonic() - self._opened_at >= self._cooldown
+
+    def success(self) -> None:
+        with self._mu:
+            self._fails = 0
+
+    def fail(self) -> None:
+        with self._mu:
+            self._fails += 1
+            if self._fails >= self._threshold:
+                self._opened_at = time.monotonic()
+
+
+class _SendQueue:
+    def __init__(self, maxlen: int) -> None:
+        self.q: "queue.Queue[Optional[Message]]" = queue.Queue(maxlen)
+        self.thread: Optional[threading.Thread] = None
+
+
+class Transport:
+    """cf. internal/transport/transport.go Transport."""
+
+    def __init__(
+        self,
+        source_address: str,
+        deployment_id: int,
+        rpc_factory: Callable[..., IRaftRPC],
+        resolver: Optional[Nodes] = None,
+        send_queue_length: int = 0,
+    ) -> None:
+        self.source_address = source_address
+        self.deployment_id = deployment_id
+        self.nodes = resolver or Nodes()
+        self._handler: Optional[IMessageHandler] = None
+        self._queues: Dict[str, _SendQueue] = {}
+        self._breakers: Dict[str, _Breaker] = {}
+        self._mu = threading.Lock()
+        self._stopped = threading.Event()
+        self._qlen = send_queue_length or 1024
+        self._metrics = {
+            "sent": 0,
+            "send_failures": 0,
+            "received": 0,
+            "connect_attempts": 0,
+            "connect_failures": 0,
+        }
+        self.rpc: IRaftRPC = rpc_factory(
+            request_handler=self._handle_request,
+            chunk_handler=self._handle_chunk,
+        )
+        # snapshot chunk sink installed by the snapshot subsystem
+        self._chunk_sink: Optional[Callable] = None
+        # monkey-test hooks (cf. transport.go:281-289)
+        self._pre_send_batch_hook: Optional[Callable] = None
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._mu:
+            qs = list(self._queues.values())
+            self._queues.clear()
+        for sq in qs:
+            try:
+                sq.q.put_nowait(None)
+            except queue.Full:
+                pass
+        for sq in qs:
+            if sq.thread is not None:
+                sq.thread.join(timeout=2)
+        self.rpc.stop()
+
+    def set_message_handler(self, handler: IMessageHandler) -> None:
+        self._handler = handler
+
+    def set_chunk_sink(self, sink: Callable) -> None:
+        self._chunk_sink = sink
+
+    def set_pre_send_batch_hook(self, hook: Optional[Callable]) -> None:
+        self._pre_send_batch_hook = hook
+
+    def metrics(self) -> dict:
+        return dict(self._metrics)
+
+    # -- receive path ----------------------------------------------------------
+    def _handle_request(self, batch: MessageBatch) -> None:
+        if self._handler is None:
+            return
+        if self.deployment_id and batch.deployment_id and (
+            batch.deployment_id != self.deployment_id
+        ):
+            return  # cross-deployment traffic dropped (transport.go:327-340)
+        if batch.source_address:
+            for m in batch.requests:
+                if m.from_:
+                    self.nodes.add_remote_address(
+                        m.cluster_id, m.from_, batch.source_address
+                    )
+        self._metrics["received"] += len(batch.requests)
+        self._handler.handle_message_batch(batch)
+
+    def _handle_chunk(self, chunk) -> bool:
+        if self._chunk_sink is None:
+            return False
+        return self._chunk_sink(chunk)
+
+    # -- send path ---------------------------------------------------------------
+    def send(self, m: Message) -> bool:
+        """Queue a message for async delivery (cf. asyncSend
+        transport.go:400-451). Returns False when dropped."""
+        addr = self.nodes.resolve(m.cluster_id, m.to)
+        if addr is None:
+            self._notify_unreachable_one(m.cluster_id, m.to)
+            return False
+        return self.send_to_address(addr, m)
+
+    def send_to_address(self, addr: str, m: Message) -> bool:
+        if self._stopped.is_set():
+            return False
+        breaker = self._get_breaker(addr)
+        if not breaker.ready():
+            return False
+        sq = self._get_queue(addr)
+        try:
+            sq.q.put_nowait(m)
+        except queue.Full:
+            return False
+        return True
+
+    def _get_breaker(self, addr: str) -> _Breaker:
+        with self._mu:
+            b = self._breakers.get(addr)
+            if b is None:
+                b = self._breakers[addr] = _Breaker()
+            return b
+
+    def _get_queue(self, addr: str) -> _SendQueue:
+        with self._mu:
+            sq = self._queues.get(addr)
+            if sq is None:
+                sq = self._queues[addr] = _SendQueue(self._qlen)
+                sq.thread = threading.Thread(
+                    target=self._process_queue,
+                    args=(addr, sq),
+                    name=f"transport-{addr}",
+                    daemon=True,
+                )
+                sq.thread.start()
+            return sq
+
+    def _process_queue(self, addr: str, sq: _SendQueue) -> None:
+        """Per-target worker: connect lazily, drain queue into batches
+        (cf. connectAndProcess/processQueue transport.go:453-557)."""
+        conn = None
+        breaker = self._get_breaker(addr)
+        try:
+            while not self._stopped.is_set():
+                try:
+                    m = sq.q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if m is None:
+                    return
+                batch = MessageBatch(
+                    requests=[m],
+                    deployment_id=self.deployment_id,
+                    source_address=self.source_address,
+                    bin_ver=BIN_VER,
+                )
+                size = _msg_size(m)
+                while size < soft.max_message_batch_size:
+                    try:
+                        m2 = sq.q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if m2 is None:
+                        return
+                    batch.requests.append(m2)
+                    size += _msg_size(m2)
+                if self._pre_send_batch_hook is not None:
+                    if not self._pre_send_batch_hook(batch):
+                        continue  # dropped by chaos hook
+                try:
+                    if conn is None:
+                        self._metrics["connect_attempts"] += 1
+                        conn = self.rpc.get_connection(addr)
+                    conn.send_message_batch(batch)
+                    breaker.success()
+                    self._metrics["sent"] += len(batch.requests)
+                except Exception:
+                    self._metrics["send_failures"] += len(batch.requests)
+                    self._metrics["connect_failures"] += 1
+                    if conn is not None:
+                        try:
+                            conn.close()
+                        except Exception:
+                            pass
+                        conn = None
+                    breaker.fail()
+                    self._notify_unreachable(addr)
+                    # drop queued traffic for the cooldown window
+                    time.sleep(0.05)
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    # -- failure fanout ---------------------------------------------------------
+    def _notify_unreachable(self, addr: str) -> None:
+        """cf. transport.go:371-386 + nodehost.go:2034-2055."""
+        if self._handler is None:
+            return
+        for cid, nid in self.nodes.reverse_resolve(addr):
+            self._handler.handle_unreachable(cid, nid)
+
+    def _notify_unreachable_one(self, cluster_id: int, node_id: int) -> None:
+        if self._handler is not None:
+            self._handler.handle_unreachable(cluster_id, node_id)
+
+
+def _msg_size(m: Message) -> int:
+    return 64 + sum(len(e.cmd) + 48 for e in m.entries)
+
+
+__all__ = ["Transport", "BIN_VER"]

@@ -1,0 +1,366 @@
+"""RSM layer tests: sessions, membership legality, managed SM apply path,
+snapshot IO format (cf. internal/rsm/statemachine_test.go,
+session_test.go, membership_test.go patterns)."""
+import io
+
+import pytest
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.peer import encode_config_change
+from dragonboat_tpu.rsm import (
+    MembershipManager,
+    SessionManager,
+    SnapshotHeader,
+    SnapshotReader,
+    SnapshotWriter,
+    StateMachineManager,
+    StreamValidator,
+    Task,
+    wrap_state_machine,
+)
+from dragonboat_tpu.rsm.session import Session
+from dragonboat_tpu.statemachine import (
+    AbortSignal,
+    IStateMachine,
+    Result,
+)
+from dragonboat_tpu.types import (
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    NOOP_CLIENT_ID,
+    SERIES_ID_FOR_REGISTER,
+    SERIES_ID_FOR_UNREGISTER,
+)
+
+
+# ---------------------------------------------------------------- sessions
+def test_session_response_cache():
+    s = Session(100)
+    s.add_response(1, Result(value=10))
+    got, has = s.get_response(1)
+    assert has and got.value == 10
+    with pytest.raises(RuntimeError):
+        s.add_response(1, Result(value=11))
+    s.clear_to(1)
+    assert s.has_responded(1)
+    _, has = s.get_response(1)
+    assert not has
+
+
+def test_session_manager_lru_eviction():
+    m = SessionManager(max_sessions=2)
+    m.register_client_id(1)
+    m.register_client_id(2)
+    m.register_client_id(3)  # evicts 1
+    assert m.get_registered_client(1) is None
+    assert m.get_registered_client(2) is not None
+    # 2 is now most recent; adding 4 evicts 3
+    m.register_client_id(4)
+    assert m.get_registered_client(3) is None
+    assert m.get_registered_client(2) is not None
+
+
+def test_session_manager_snapshot_roundtrip():
+    m = SessionManager(max_sessions=8)
+    for cid in (5, 6, 7):
+        m.register_client_id(cid)
+    s = m.get_registered_client(6)
+    s.add_response(3, Result(value=33, data=b"abc"))
+    s.responded_up_to = 2
+    blob = m.save()
+    m2 = SessionManager(max_sessions=8)
+    m2.load(blob)
+    s2 = m2.get_registered_client(6)
+    got, has = s2.get_response(3)
+    assert has and got.value == 33 and got.data == b"abc"
+    assert m.hash() == m2.hash()
+
+
+# -------------------------------------------------------------- membership
+def mk_members():
+    m = MembershipManager(1, 1, ordered=False)
+    m.members.addresses = {1: "a:1", 2: "a:2", 3: "a:3"}
+    return m
+
+
+def test_membership_add_remove():
+    m = mk_members()
+    ok = m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=4, address="a:4"), 10
+    )
+    assert ok and m.members.addresses[4] == "a:4"
+    assert m.members.config_change_id == 10
+    ok = m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.REMOVE_NODE, node_id=4), 11
+    )
+    assert ok and 4 not in m.members.addresses and 4 in m.members.removed
+    # re-adding a removed node is rejected
+    ok = m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=4, address="a:9"), 12
+    )
+    assert not ok
+
+
+def test_membership_rejects_dup_address():
+    m = mk_members()
+    ok = m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=9, address="a:2"), 10
+    )
+    assert not ok
+
+
+def test_membership_observer_promotion():
+    m = mk_members()
+    assert m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_OBSERVER, node_id=5, address="a:5"), 10
+    )
+    # promote with same address ok
+    assert m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=5, address="a:5"), 11
+    )
+    assert 5 in m.members.addresses and 5 not in m.members.observers
+
+
+def test_membership_observer_promotion_wrong_address():
+    m = mk_members()
+    assert m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_OBSERVER, node_id=5, address="a:5"), 10
+    )
+    assert not m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=5, address="a:6"), 11
+    )
+
+
+def test_membership_cannot_delete_only_node():
+    m = MembershipManager(1, 1)
+    m.members.addresses = {1: "a:1"}
+    assert not m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.REMOVE_NODE, node_id=1), 5
+    )
+
+
+def test_membership_ordered_ccid():
+    m = MembershipManager(1, 1, ordered=True)
+    m.members.addresses = {1: "a:1", 2: "a:2"}
+    m.members.config_change_id = 7
+    bad = ConfigChange(
+        type=ConfigChangeType.ADD_NODE, node_id=3, address="a:3", config_change_id=6
+    )
+    assert not m.handle_config_change(bad, 10)
+    good = ConfigChange(
+        type=ConfigChangeType.ADD_NODE, node_id=3, address="a:3", config_change_id=7
+    )
+    assert m.handle_config_change(good, 10)
+
+
+def test_membership_witness_rules():
+    m = mk_members()
+    assert m.handle_config_change(
+        ConfigChange(type=ConfigChangeType.ADD_WITNESS, node_id=6, address="a:6"), 10
+    )
+    # adding an existing witness as full node must raise (illegal promotion)
+    with pytest.raises(RuntimeError):
+        m._apply(
+            ConfigChange(type=ConfigChangeType.ADD_NODE, node_id=6, address="a:6"), 11
+        )
+
+
+# ----------------------------------------------------------- snapshot io
+def test_snapshot_io_roundtrip():
+    buf = io.BytesIO()
+    hdr = SnapshotHeader(
+        index=100,
+        term=7,
+        smtype=1,
+        membership=Membership(addresses={1: "a:1"}, config_change_id=3),
+    )
+    payload = bytes(range(256)) * 5000  # > 1MB, multiple blocks
+    with SnapshotWriter(buf, hdr, session=b"sess-image") as w:
+        w.write(payload)
+    buf.seek(0)
+    r = SnapshotReader(buf)
+    assert r.header.index == 100 and r.header.term == 7
+    assert r.header.membership.addresses == {1: "a:1"}
+    assert r.session == b"sess-image"
+    got = r.read()
+    assert got == payload
+
+
+def test_snapshot_io_detects_corruption():
+    buf = io.BytesIO()
+    hdr = SnapshotHeader(index=1, term=1)
+    with SnapshotWriter(buf, hdr, session=b"") as w:
+        w.write(b"x" * 100000)
+    raw = bytearray(buf.getvalue())
+    raw[len(raw) // 2] ^= 0xFF  # flip a payload bit
+    v = StreamValidator()
+    v.feed(bytes(raw))
+    assert not v.valid()
+    v2 = StreamValidator()
+    v2.feed(buf.getvalue())
+    assert v2.valid()
+
+
+# ------------------------------------------------------- manager apply path
+class KVSM(IStateMachine):
+    def __init__(self):
+        self.data = {}
+        self.update_count = 0
+
+    def update(self, cmd: bytes) -> Result:
+        self.update_count += 1
+        k, v = cmd.decode().split("=", 1)
+        self.data[k] = v
+        return Result(value=len(self.data))
+
+    def lookup(self, q):
+        return self.data.get(q)
+
+    def save_snapshot(self, w, files, done):
+        import json
+
+        w.write(json.dumps(self.data, sort_keys=True).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        import json
+
+        self.data = json.loads(r.read().decode())
+
+
+class FakeNodeProxy:
+    def __init__(self):
+        self.updates = []
+        self.ccs = []
+        self.cc_results = []
+
+    def node_ready(self):
+        pass
+
+    def apply_update(self, entry, result, rejected, ignored, notify_read):
+        self.updates.append((entry.index, result, rejected, ignored))
+
+    def apply_config_change(self, cc):
+        self.ccs.append(cc)
+
+    def config_change_processed(self, key, accepted):
+        self.cc_results.append((key, accepted))
+
+    def node_id(self):
+        return 1
+
+    def cluster_id(self):
+        return 5
+
+    def should_stop(self):
+        return False
+
+
+def mk_manager(sm=None):
+    sm = sm or KVSM()
+    managed = wrap_state_machine(sm, 5, 1)
+    proxy = FakeNodeProxy()
+    cfg = Config(node_id=1, cluster_id=5, election_rtt=10, heartbeat_rtt=2)
+    mgr = StateMachineManager(None, managed, proxy, cfg)
+    return mgr, sm, proxy
+
+
+def entry(index, cmd=b"", client=NOOP_CLIENT_ID, series=0, responded=0, term=1):
+    return Entry(
+        index=index,
+        term=term,
+        cmd=cmd,
+        client_id=client,
+        series_id=series,
+        responded_to=responded,
+    )
+
+
+def run_tasks(mgr, *tasks):
+    for t in tasks:
+        mgr.task_queue.add(t)
+    batch, apply = [], []
+    return mgr.handle(batch, apply)
+
+
+def test_manager_applies_noop_session_entries():
+    mgr, sm, proxy = mk_manager()
+    run_tasks(mgr, Task(entries=[entry(1, b"a=1"), entry(2, b"b=2")]))
+    assert sm.data == {"a": "1", "b": "2"}
+    assert mgr.last_applied_index() == 2
+    assert [u[0] for u in proxy.updates] == [1, 2]
+
+
+def test_manager_session_dedup():
+    mgr, sm, proxy = mk_manager()
+    # register client 77
+    reg = entry(1, client=77, series=SERIES_ID_FOR_REGISTER)
+    run_tasks(mgr, Task(entries=[reg]))
+    assert proxy.updates[-1][1].value == 77
+    # first proposal
+    e1 = entry(2, b"k=v", client=77, series=1)
+    run_tasks(mgr, Task(entries=[e1]))
+    assert sm.update_count == 1
+    # duplicate of series 1 must NOT re-apply; cached result returned
+    dup = entry(3, b"k=v2", client=77, series=1)
+    run_tasks(mgr, Task(entries=[dup]))
+    assert sm.update_count == 1
+    assert sm.data == {"k": "v"}
+    assert proxy.updates[-1][1] == proxy.updates[-2][1]
+    # acknowledged responses are evicted; a replay below responded_to is
+    # flagged ignored
+    e2 = entry(4, b"k2=v", client=77, series=2, responded=1)
+    run_tasks(mgr, Task(entries=[e2]))
+    assert sm.update_count == 2
+    old = entry(5, b"k=zzz", client=77, series=1, responded=1)
+    run_tasks(mgr, Task(entries=[old]))
+    assert sm.update_count == 2
+    assert proxy.updates[-1][3]  # ignored
+    # unregister
+    unreg = entry(6, client=77, series=SERIES_ID_FOR_UNREGISTER)
+    run_tasks(mgr, Task(entries=[unreg]))
+    # proposals from unregistered client rejected
+    e3 = entry(7, b"x=y", client=77, series=3)
+    run_tasks(mgr, Task(entries=[e3]))
+    assert proxy.updates[-1][2]  # rejected
+    assert sm.update_count == 2
+
+
+def test_manager_config_change():
+    mgr, sm, proxy = mk_manager()
+    cc = ConfigChange(
+        type=ConfigChangeType.ADD_NODE, node_id=2, address="a:2", initialize=True
+    )
+    e = Entry(
+        index=1, term=1, type=EntryType.CONFIG_CHANGE, cmd=encode_config_change(cc),
+        key=42,
+    )
+    run_tasks(mgr, Task(entries=[e]))
+    assert proxy.cc_results == [(42, True)]
+    assert mgr.get_membership().addresses == {2: "a:2"}
+    # duplicate add rejected
+    e2 = Entry(
+        index=2, term=1, type=EntryType.CONFIG_CHANGE, cmd=encode_config_change(cc),
+        key=43,
+    )
+    run_tasks(mgr, Task(entries=[e2]))
+    assert proxy.cc_results[-1] == (43, False)
+
+
+def test_manager_snapshot_task_interrupts_batch():
+    mgr, sm, proxy = mk_manager()
+    t1 = Task(entries=[entry(1, b"a=1")])
+    t2 = Task(snapshot_requested=True)
+    t3 = Task(entries=[entry(2, b"b=2")])
+    mgr.task_queue.add(t1)
+    mgr.task_queue.add(t2)
+    mgr.task_queue.add(t3)
+    batch, apply = [], []
+    got = mgr.handle(batch, apply)
+    assert got is t2
+    assert sm.data == {"a": "1"}  # t1 applied before returning snapshot task
+    got2 = mgr.handle(batch, apply)
+    assert got2 is None
+    assert sm.data == {"a": "1", "b": "2"}

@@ -1,0 +1,203 @@
+"""Transport tests: framing, loopback + TCP delivery, batching, breaker +
+unreachable fanout (cf. internal/transport/transport_test.go patterns)."""
+import socket
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.raftio import IMessageHandler
+from dragonboat_tpu.transport import Transport, loopback_factory
+from dragonboat_tpu.transport.loopback import _Registry
+from dragonboat_tpu.transport.tcp import tcp_factory
+from dragonboat_tpu.types import Entry, Message, MessageType
+
+
+class CollectingHandler(IMessageHandler):
+    def __init__(self):
+        self.batches = []
+        self.unreachable = []
+        self.event = threading.Event()
+
+    def handle_message_batch(self, batch):
+        self.batches.append(batch)
+        self.event.set()
+        return 0, len(batch.requests)
+
+    def handle_unreachable(self, cluster_id, node_id):
+        self.unreachable.append((cluster_id, node_id))
+
+    def handle_snapshot_status(self, cluster_id, node_id, failed):
+        pass
+
+    def handle_snapshot(self, cluster_id, node_id, from_):
+        pass
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def mk_msg(cid=1, to=2, frm=1, n=1):
+    return Message(
+        type=MessageType.REPLICATE,
+        cluster_id=cid,
+        to=to,
+        from_=frm,
+        term=3,
+        entries=[Entry(index=i + 1, term=3, cmd=b"payload") for i in range(n)],
+    )
+
+
+def mk_pair(registry, a_addr="hostA:1", b_addr="hostB:2", deployment_id=7):
+    ha, hb = CollectingHandler(), CollectingHandler()
+    ta = Transport(a_addr, deployment_id, loopback_factory(a_addr, registry))
+    tb = Transport(b_addr, deployment_id, loopback_factory(b_addr, registry))
+    ta.set_message_handler(ha)
+    tb.set_message_handler(hb)
+    ta.start()
+    tb.start()
+    return ta, tb, ha, hb
+
+
+def test_loopback_roundtrip():
+    reg = _Registry()
+    ta, tb, ha, hb = mk_pair(reg)
+    try:
+        ta.nodes.add_node(1, 2, "hostB:2")
+        assert ta.send(mk_msg())
+        assert wait_for(lambda: hb.batches)
+        got = hb.batches[0]
+        assert got.source_address == "hostA:1"
+        assert got.requests[0].entries[0].cmd == b"payload"
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_send_unresolvable_reports_unreachable():
+    reg = _Registry()
+    ta, tb, ha, hb = mk_pair(reg)
+    try:
+        assert not ta.send(mk_msg(cid=9, to=9))
+        assert (9, 9) in ha.unreachable
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_deployment_id_gating():
+    reg = _Registry()
+    ha, hb = CollectingHandler(), CollectingHandler()
+    ta = Transport("a:1", 7, loopback_factory("a:1", reg))
+    tb = Transport("b:2", 8, loopback_factory("b:2", reg))  # different deployment
+    ta.set_message_handler(ha)
+    tb.set_message_handler(hb)
+    ta.start()
+    tb.start()
+    try:
+        ta.nodes.add_node(1, 2, "b:2")
+        ta.send(mk_msg())
+        time.sleep(0.3)
+        assert hb.batches == []  # dropped at receive
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_breaker_trips_and_unreachable_fanout():
+    reg = _Registry()
+    ta, tb, ha, hb = mk_pair(reg)
+    try:
+        ta.nodes.add_node(1, 2, "hostB:2")
+        ta.nodes.add_node(3, 5, "hostB:2")
+        ta.rpc.blocked = True  # outbound sends now fail
+        ta.send(mk_msg())
+        assert wait_for(lambda: (1, 2) in ha.unreachable and (3, 5) in ha.unreachable)
+        # breaker open: send is refused immediately
+        assert wait_for(lambda: not ta.send(mk_msg()))
+        ta.rpc.blocked = False
+        time.sleep(1.1)  # cooldown
+        assert ta.send(mk_msg())
+        assert wait_for(lambda: hb.batches)
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_learned_remote_addresses():
+    reg = _Registry()
+    ta, tb, ha, hb = mk_pair(reg)
+    try:
+        ta.nodes.add_node(1, 2, "hostB:2")
+        ta.send(mk_msg(cid=1, to=2, frm=5))
+        assert wait_for(lambda: hb.batches)
+        # B learned that (1,5) lives at hostA:1 and can reply without config
+        assert tb.nodes.resolve(1, 5) == "hostA:1"
+        tb.send(Message(type=MessageType.REPLICATE_RESP, cluster_id=1, to=5, from_=2))
+        assert wait_for(lambda: ha.batches)
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_tcp_transport_roundtrip():
+    pa, pb = free_port(), free_port()
+    addr_a, addr_b = f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"
+    ha, hb = CollectingHandler(), CollectingHandler()
+    ta = Transport(addr_a, 7, tcp_factory(addr_a))
+    tb = Transport(addr_b, 7, tcp_factory(addr_b))
+    ta.set_message_handler(ha)
+    tb.set_message_handler(hb)
+    ta.start()
+    tb.start()
+    try:
+        ta.nodes.add_node(1, 2, addr_b)
+        tb.nodes.add_node(1, 1, addr_a)
+        big = mk_msg(n=50)
+        assert ta.send(big)
+        assert wait_for(lambda: hb.batches)
+        assert len(hb.batches[0].requests[0].entries) == 50
+        # reply direction over its own connection
+        tb.send(Message(type=MessageType.REPLICATE_RESP, cluster_id=1, to=1, from_=2))
+        assert wait_for(lambda: ha.batches)
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_tcp_many_messages_batching():
+    pa, pb = free_port(), free_port()
+    addr_a, addr_b = f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"
+    ha, hb = CollectingHandler(), CollectingHandler()
+    ta = Transport(addr_a, 0, tcp_factory(addr_a))
+    tb = Transport(addr_b, 0, tcp_factory(addr_b))
+    ta.set_message_handler(ha)
+    tb.set_message_handler(hb)
+    ta.start()
+    tb.start()
+    try:
+        ta.nodes.add_node(1, 2, addr_b)
+        for _ in range(200):
+            ta.send(mk_msg())
+        assert wait_for(
+            lambda: sum(len(b.requests) for b in hb.batches) == 200
+        )
+        # batching must have coalesced (fewer batches than messages)
+        assert len(hb.batches) < 200
+    finally:
+        ta.stop()
+        tb.stop()
