@@ -393,16 +393,18 @@ class Bootstrap:
     type: int = 0
 
     def validate(self, nodes: dict, join: bool, smtype: int) -> bool:
-        # cf. raftpb/raft.go:221-258 Bootstrap.Validate
+        # cf. raftpb/raft.go:221-258 Bootstrap.Validate. Restarting with an
+        # empty member list is the normal path once a bootstrap record
+        # exists; a non-empty list must match the original exactly.
         if not self.join and len(self.addresses) == 0:
-            return False
-        if not self.join and join:
             return False
         if self.join and len(nodes) > 0:
             return False
+        if join and len(self.addresses) > 0:
+            return False
         if self.type != 0 and smtype != 0 and self.type != smtype:
             return False
-        if not self.join and not join:
+        if nodes and not self.join:
             if len(nodes) != len(self.addresses):
                 return False
             for nid, addr in nodes.items():

@@ -1,0 +1,378 @@
+// Native log-structured KV store backing the sharded LogDB.
+//
+// TPU-era equivalent of the reference's vendored C++ storage backends
+// (internal/logdb/kv/leveldb/levigo/deps, internal/logdb/kv/rocksdb — the
+// reference links RocksDB/LevelDB via cgo; here the native store is built
+// from scratch): an append-only, CRC-framed WAL with group-committed write
+// batches (one fsync per batch, cf. sharded_rdb.go:149-156 "single shard per
+// update batch"), an ordered in-memory table serving all reads, and
+// crash-safe compaction (tmp + fsync + rename, then WAL truncate).
+//
+// The on-disk record format is byte-compatible with the pure-Python WalKV
+// (dragonboat_tpu/storage/kv.py): little-endian header
+//   {u32 total_len, u8 op, u32 klen, u32 vlen} key value {u32 crc32}
+// where crc32 covers header+key+value. A torn or corrupt tail record is
+// detected by CRC/length and replay stops there (same recovery rule as the
+// reference's WAL usage and kv.py:_replay).
+//
+// C ABI (ctypes-friendly): every call crosses the FFI once per *batch* or
+// per *range*, never per key — the Python side serializes a whole write
+// batch into one blob and the iterator returns one serialized result blob.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <string>
+#include <vector>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <zlib.h>
+
+namespace {
+
+constexpr uint8_t OP_PUT = 0;
+constexpr uint8_t OP_DEL = 1;
+constexpr uint8_t OP_RANGE_DEL = 2;
+constexpr size_t HDR = 4 + 1 + 4 + 4;  // total_len, op, klen, vlen
+
+inline void put_u32(std::string& b, uint32_t v) {
+  b.push_back(static_cast<char>(v & 0xff));
+  b.push_back(static_cast<char>((v >> 8) & 0xff));
+  b.push_back(static_cast<char>((v >> 16) & 0xff));
+  b.push_back(static_cast<char>((v >> 24) & 0xff));
+}
+
+inline uint32_t get_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+struct Op {
+  uint8_t op;
+  std::string k;
+  std::string v;
+};
+
+class WalKV {
+ public:
+  WalKV(std::string dir, bool use_fsync)
+      : dir_(std::move(dir)), fsync_(use_fsync) {}
+
+  // returns empty string on success, error message on failure
+  std::string Open() {
+    ::mkdir(dir_.c_str(), 0755);
+    struct stat st;
+    if (::stat(dir_.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) {
+      return "cannot create dir " + dir_;
+    }
+    Replay(dir_ + "/table.log");
+    Replay(dir_ + "/wal.log");
+    fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                 0644);
+    if (fd_ < 0) return "cannot open wal.log in " + dir_;
+    return "";
+  }
+
+  ~WalKV() {
+    if (fd_ >= 0) {
+      if (fsync_) ::fsync(fd_);
+      ::close(fd_);
+    }
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fd_ >= 0) {
+      if (fsync_) ::fsync(fd_);
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool Get(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(k);
+    if (it == table_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  // blob = (u8 op, u32 klen, u32 vlen, key, val)*
+  int CommitBatch(const uint8_t* blob, size_t len) {
+    std::vector<Op> ops;
+    size_t off = 0;
+    while (off < len) {
+      if (off + 9 > len) return -1;
+      Op o;
+      o.op = blob[off];
+      uint32_t klen = get_u32(blob + off + 1);
+      uint32_t vlen = get_u32(blob + off + 5);
+      off += 9;
+      if (off + klen + vlen > len) return -1;
+      o.k.assign(reinterpret_cast<const char*>(blob + off), klen);
+      o.v.assign(reinterpret_cast<const char*>(blob + off + klen), vlen);
+      off += klen + vlen;
+      ops.push_back(std::move(o));
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    std::string buf;
+    for (const auto& o : ops) AppendRec(buf, o);
+    if (WriteAll(fd_, buf.data(), buf.size()) != 0) return -2;
+    if (fsync_ && ::fsync(fd_) != 0) return -3;
+    for (const auto& o : ops) Apply(o);
+    pending_compact_ += ops.size();
+    return 0;
+  }
+
+  // serialized (u32 klen, u32 vlen, key, val)* for keys in [fk, lk) or
+  // [fk, lk]; caller frees via walkv_free
+  void Iterate(const std::string& fk, const std::string& lk, bool inc_last,
+               uint8_t** out, size_t* outlen) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string buf;
+    auto it = table_.lower_bound(fk);
+    for (; it != table_.end(); ++it) {
+      if (inc_last ? (it->first > lk) : (it->first >= lk)) break;
+      put_u32(buf, static_cast<uint32_t>(it->first.size()));
+      put_u32(buf, static_cast<uint32_t>(it->second.size()));
+      buf.append(it->first);
+      buf.append(it->second);
+    }
+    *out = static_cast<uint8_t*>(::malloc(buf.size() ? buf.size() : 1));
+    std::memcpy(*out, buf.data(), buf.size());
+    *outlen = buf.size();
+  }
+
+  int BulkRemove(const std::string& fk, const std::string& lk) {
+    Op o{OP_RANGE_DEL, fk, lk};
+    std::lock_guard<std::mutex> g(mu_);
+    std::string buf;
+    AppendRec(buf, o);
+    if (WriteAll(fd_, buf.data(), buf.size()) != 0) return -2;
+    if (fsync_ && ::fsync(fd_) != 0) return -3;
+    Apply(o);
+    ++pending_compact_;
+    return 0;
+  }
+
+  // Rewrite the live table into table.log (tmp+fsync+rename), then truncate
+  // the WAL. Crash-safe: the WAL is only truncated after the table is
+  // durable, and replay applies table.log before wal.log.
+  int FullCompaction() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string tmp = dir_ + "/table.log.tmp";
+    int tfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (tfd < 0) return -1;
+    std::string buf;
+    for (const auto& kv : table_) {
+      Op o{OP_PUT, kv.first, kv.second};
+      AppendRec(buf, o);
+      if (buf.size() > (1u << 20)) {
+        if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
+          ::close(tfd);
+          return -2;
+        }
+        buf.clear();
+      }
+    }
+    if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
+      ::close(tfd);
+      return -2;
+    }
+    if (::fsync(tfd) != 0) {
+      ::close(tfd);
+      return -3;
+    }
+    ::close(tfd);
+    if (::rename(tmp.c_str(), (dir_ + "/table.log").c_str()) != 0) return -4;
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_TRUNC,
+                 0644);
+    if (fd_ < 0) return -5;
+    if (fsync_ && ::fsync(fd_) != 0) return -6;
+    pending_compact_ = 0;
+    return 0;
+  }
+
+  int MaybeCompact(uint64_t threshold) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (pending_compact_ < threshold) return 0;
+    }
+    return FullCompaction();
+  }
+
+  uint64_t Count() {
+    std::lock_guard<std::mutex> g(mu_);
+    return table_.size();
+  }
+
+ private:
+  static int WriteAll(int fd, const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::write(fd, p, n);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return -1;
+      }
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+    return 0;
+  }
+
+  void AppendRec(std::string& buf, const Op& o) {
+    std::string rec;
+    rec.reserve(HDR + o.k.size() + o.v.size() + 4);
+    put_u32(rec,
+            static_cast<uint32_t>(HDR + o.k.size() + o.v.size() + 4));
+    rec.push_back(static_cast<char>(o.op));
+    put_u32(rec, static_cast<uint32_t>(o.k.size()));
+    put_u32(rec, static_cast<uint32_t>(o.v.size()));
+    rec.append(o.k);
+    rec.append(o.v);
+    uint32_t crc = static_cast<uint32_t>(
+        ::crc32(0, reinterpret_cast<const Bytef*>(rec.data()),
+                static_cast<uInt>(rec.size())));
+    put_u32(rec, crc);
+    buf.append(rec);
+  }
+
+  void Apply(const Op& o) {
+    switch (o.op) {
+      case OP_PUT:
+        table_[o.k] = o.v;
+        break;
+      case OP_DEL:
+        table_.erase(o.k);
+        break;
+      case OP_RANGE_DEL: {
+        auto lo = table_.lower_bound(o.k);
+        auto hi = table_.lower_bound(o.v);
+        table_.erase(lo, hi);
+        break;
+      }
+      default:
+        break;
+    }
+  }
+
+  void Replay(const std::string& path) {
+    FILE* f = ::fopen(path.c_str(), "rb");
+    if (!f) return;
+    ::fseek(f, 0, SEEK_END);
+    long sz = ::ftell(f);
+    ::fseek(f, 0, SEEK_SET);
+    std::vector<uint8_t> data(static_cast<size_t>(sz));
+    if (sz > 0 && ::fread(data.data(), 1, data.size(), f) != data.size()) {
+      ::fclose(f);
+      return;
+    }
+    ::fclose(f);
+    size_t off = 0;
+    while (off + HDR <= data.size()) {
+      uint32_t total = get_u32(&data[off]);
+      uint8_t op = data[off + 4];
+      uint32_t klen = get_u32(&data[off + 5]);
+      uint32_t vlen = get_u32(&data[off + 9]);
+      size_t end = off + HDR + klen + vlen + 4;
+      if (total != HDR + klen + vlen + 4 || end > data.size()) break;
+      uint32_t want = get_u32(&data[end - 4]);
+      uint32_t got = static_cast<uint32_t>(
+          ::crc32(0, &data[off], static_cast<uInt>(end - 4 - off)));
+      if (want != got) break;  // torn/corrupt tail
+      Op o;
+      o.op = op;
+      o.k.assign(reinterpret_cast<const char*>(&data[off + HDR]), klen);
+      o.v.assign(reinterpret_cast<const char*>(&data[off + HDR + klen]),
+                 vlen);
+      Apply(o);
+      off = end;
+    }
+  }
+
+  std::string dir_;
+  bool fsync_;
+  int fd_ = -1;
+  std::map<std::string, std::string> table_;
+  uint64_t pending_compact_ = 0;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* walkv_open(const char* dir, int use_fsync, char* err, int errlen) {
+  auto* kv = new (std::nothrow) WalKV(dir, use_fsync != 0);
+  if (!kv) return nullptr;
+  std::string e = kv->Open();
+  if (!e.empty()) {
+    if (err && errlen > 0) {
+      std::snprintf(err, static_cast<size_t>(errlen), "%s", e.c_str());
+    }
+    delete kv;
+    return nullptr;
+  }
+  return kv;
+}
+
+void walkv_close(void* h) {
+  auto* kv = static_cast<WalKV*>(h);
+  kv->Close();
+  delete kv;
+}
+
+int walkv_get(void* h, const uint8_t* k, size_t klen, uint8_t** val,
+              size_t* vlen) {
+  std::string out;
+  if (!static_cast<WalKV*>(h)->Get(std::string(reinterpret_cast<const char*>(k), klen),
+                                   &out)) {
+    return 0;
+  }
+  *val = static_cast<uint8_t*>(::malloc(out.size() ? out.size() : 1));
+  std::memcpy(*val, out.data(), out.size());
+  *vlen = out.size();
+  return 1;
+}
+
+void walkv_free(void* p) { ::free(p); }
+
+int walkv_commit_batch(void* h, const uint8_t* blob, size_t len) {
+  return static_cast<WalKV*>(h)->CommitBatch(blob, len);
+}
+
+void walkv_iterate(void* h, const uint8_t* fk, size_t fklen, const uint8_t* lk,
+                   size_t lklen, int inc_last, uint8_t** out, size_t* outlen) {
+  static_cast<WalKV*>(h)->Iterate(
+      std::string(reinterpret_cast<const char*>(fk), fklen),
+      std::string(reinterpret_cast<const char*>(lk), lklen), inc_last != 0,
+      out, outlen);
+}
+
+int walkv_bulk_remove(void* h, const uint8_t* fk, size_t fklen,
+                      const uint8_t* lk, size_t lklen) {
+  return static_cast<WalKV*>(h)->BulkRemove(
+      std::string(reinterpret_cast<const char*>(fk), fklen),
+      std::string(reinterpret_cast<const char*>(lk), lklen));
+}
+
+int walkv_full_compaction(void* h) {
+  return static_cast<WalKV*>(h)->FullCompaction();
+}
+
+int walkv_maybe_compact(void* h, uint64_t threshold) {
+  return static_cast<WalKV*>(h)->MaybeCompact(threshold);
+}
+
+uint64_t walkv_count(void* h) { return static_cast<WalKV*>(h)->Count(); }
+
+}  // extern "C"
