@@ -17,9 +17,29 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..logger import get_logger
 from ..settings import hard, soft
+from ..trace import Profiler
 from ..types import Update
 from .node import Node
+
+_plog = get_logger("execengine")
+
+
+class _NullProfiler:
+    """Zero-cost stand-in when profiling is disabled."""
+
+    def new_iteration(self, n_groups: int = 0) -> None:
+        pass
+
+    def start(self) -> None:
+        pass
+
+    def end(self, stage: str) -> None:
+        pass
+
+
+_NULL_PROFILER = _NullProfiler()
 
 
 class WorkReady:
@@ -73,6 +93,7 @@ class ExecEngine:
         num_step_workers: Optional[int] = None,
         num_task_workers: Optional[int] = None,
         num_snapshot_workers: int = 4,
+        sample_ratio: Optional[int] = None,
     ) -> None:
         self._logdb = logdb
         # Python threads contend on the GIL: default pools are smaller than
@@ -88,6 +109,16 @@ class ExecEngine:
         self.node_ready = WorkReady(self._n_step)
         self.task_ready = WorkReady(self._n_task)
         self.snapshot_ready = WorkReady(self._n_snap)
+        # per-step-worker sampled profilers (cf. execengine.go:161-169);
+        # ratio 0 (the default, cf. soft.latency_sample_ratio) disables
+        # profiling entirely — no timing calls, no sample memory
+        ratio = (
+            sample_ratio if sample_ratio is not None
+            else soft.latency_sample_ratio
+        )
+        self.profilers = (
+            [Profiler(ratio) for _ in range(self._n_step)] if ratio > 0 else []
+        )
         self._threads: List[threading.Thread] = []
         for i in range(self._n_step):
             t = threading.Thread(
@@ -149,14 +180,17 @@ class ExecEngine:
                         nodes.append(n)
             if nodes:
                 try:
-                    self.exec_nodes(nodes)
+                    self.exec_nodes(nodes, worker)
                 except Exception:  # a group failure must not kill the worker
                     import traceback
 
                     traceback.print_exc()
 
-    def exec_nodes(self, nodes: List[Node]) -> None:
+    def exec_nodes(self, nodes: List[Node], worker: int = 0) -> None:
         """THE hot loop (cf. execNodes execengine.go:474-560)."""
+        prof = self.profilers[worker] if self.profilers else _NULL_PROFILER
+        prof.new_iteration(len(nodes))
+        prof.start()
         updates: List[Tuple[Node, Update]] = []
         for node in nodes:
             if not node.initialized.is_set():
@@ -165,19 +199,27 @@ class ExecEngine:
             if ud is not None:
                 node.process_dropped(ud)
                 updates.append((node, ud))
+        prof.end("step")
         if not updates:
             return
         # 1. fast-apply: committed entries reach the SM before the fsync when
         #    safe (peer.set_fast_apply decided per update)
+        prof.start()
         for node, ud in updates:
             if ud.fast_apply:
                 node.apply_raft_update(ud)
+        prof.end("fast_apply")
         # 2. Replicate messages leave before the local fsync
+        prof.start()
         for node, ud in updates:
             node.send_replicate_messages(ud)
+        prof.end("send")
         # 3. one batched fsynced write for every group this worker stepped
+        prof.start()
         self._logdb.save_raft_state([ud for _, ud in updates])
+        prof.end("save")
         # 4. stable apply for the rest
+        prof.start()
         for node, ud in updates:
             if not ud.fast_apply:
                 node.apply_raft_update(ud)
@@ -185,6 +227,7 @@ class ExecEngine:
         for node, ud in updates:
             node.process_raft_update(ud)
             node.commit_raft_update(ud)
+        prof.end("exec")
 
     # ---------------------------------------------------------- task workers
     def _task_worker_main(self, worker: int) -> None:
@@ -232,6 +275,11 @@ class ExecEngine:
         self.snapshot_ready.wake_all()
         for t in self._threads:
             t.join(timeout=2)
+        # dump sampled stage latencies (cf. execengine.go:197-211)
+        for i, prof in enumerate(self.profilers):
+            report = prof.report()
+            if report:
+                _plog.infof("step worker %d stage latencies:\n%s", i, report)
 
 
 __all__ = ["ExecEngine", "WorkReady"]

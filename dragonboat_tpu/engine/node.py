@@ -516,6 +516,11 @@ class Node:
                     node.events.leader_updated(cluster_id, node_id, leader_id, term)
 
             def __getattr__(self, name):
+                # forward the full event vocabulary (campaign_launched,
+                # proposal_dropped, ... cf. internal/server/event.go:75-83)
+                if node.events is not None:
+                    return getattr(node.events, name)
+
                 def noop(*a, **k):
                     return None
 

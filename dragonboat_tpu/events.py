@@ -1,0 +1,158 @@
+"""Raft event aggregation + Prometheus-style health metrics.
+
+cf. reference event.go:30-141: a raftEventListener sits between the raft
+core's event callbacks and (a) per-node gauges/counters exported in
+Prometheus text exposition format (WriteHealthMetrics event.go:30-32) and
+(b) the user's IRaftEventListener (LeaderUpdated via a dedicated queue —
+nodehost.go:1686-1701; here the user callback runs on a single dispatcher
+thread so a slow listener can't stall step workers).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+from .raftio import IRaftEventListener, LeaderInfo
+
+_LabelKey = Tuple[int, int]  # (cluster_id, node_id)
+
+
+class MetricsRegistry:
+    """Minimal counter/gauge registry with Prometheus text exposition."""
+
+    def __init__(self, prefix: str = "dragonboat_tpu") -> None:
+        self._prefix = prefix
+        self._mu = threading.Lock()
+        self._counters: Dict[str, Dict[_LabelKey, float]] = {}
+        self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
+
+    def inc(self, name: str, key: _LabelKey, delta: float = 1.0) -> None:
+        with self._mu:
+            self._counters.setdefault(name, {})
+            self._counters[name][key] = self._counters[name].get(key, 0.0) + delta
+
+    def set_gauge(self, name: str, key: _LabelKey, value: float) -> None:
+        with self._mu:
+            self._gauges.setdefault(name, {})[key] = value
+
+    def counter_value(self, name: str, key: _LabelKey) -> float:
+        with self._mu:
+            return self._counters.get(name, {}).get(key, 0.0)
+
+    def gauge_value(self, name: str, key: _LabelKey) -> Optional[float]:
+        with self._mu:
+            return self._gauges.get(name, {}).get(key)
+
+    def write(self, w) -> None:
+        """Prometheus text exposition (cf. WriteHealthMetrics event.go:30)."""
+        with self._mu:
+            for kind, table in (("counter", self._counters), ("gauge", self._gauges)):
+                for name in sorted(table):
+                    full = f"{self._prefix}_{name}"
+                    w.write(f"# TYPE {full} {kind}\n")
+                    for (cid, nid), v in sorted(table[name].items()):
+                        w.write(
+                            f'{full}{{clusterid="{cid}",nodeid="{nid}"}} {v:g}\n'
+                        )
+
+
+class RaftEventAggregator:
+    """Receives the raft core's event callbacks (via the node's adapter),
+    updates metrics, and forwards LeaderUpdated to the user listener
+    (cf. event.go:34-141 raftEventListener)."""
+
+    def __init__(
+        self,
+        metrics: MetricsRegistry,
+        user_listener: Optional[IRaftEventListener] = None,
+        enable_metrics: bool = True,
+    ) -> None:
+        self.metrics = metrics
+        self._user = user_listener
+        self._enabled = enable_metrics
+        self._q: "queue.Queue[Optional[LeaderInfo]]" = queue.Queue(maxsize=4096)
+        self._thread: Optional[threading.Thread] = None
+        if user_listener is not None:
+            self._thread = threading.Thread(
+                target=self._dispatch_main, name="raft-event-dispatch", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _dispatch_main(self) -> None:
+        while True:
+            info = self._q.get()
+            if info is None:
+                return
+            try:
+                self._user.leader_updated(info)
+            except Exception:
+                pass  # user listener errors must not kill the dispatcher
+
+    # -- callbacks from the raft core (all on step-worker threads) ----------
+    def leader_updated(self, cluster_id, node_id, leader_id, term) -> None:
+        if self._enabled:
+            key = (cluster_id, node_id)
+            self.metrics.set_gauge("raftnode_has_leader", key, 1.0 if leader_id else 0.0)
+            self.metrics.set_gauge("raftnode_leader_id", key, float(leader_id))
+            self.metrics.set_gauge("raftnode_term", key, float(term))
+        if self._user is not None:
+            try:
+                self._q.put_nowait(
+                    LeaderInfo(
+                        cluster_id=cluster_id, node_id=node_id,
+                        leader_id=leader_id, term=term,
+                    )
+                )
+            except queue.Full:
+                pass
+
+    def campaign_launched(self, cluster_id, node_id, term) -> None:
+        if self._enabled:
+            self.metrics.inc("raftnode_campaign_launched_total", (cluster_id, node_id))
+
+    def campaign_skipped(self, cluster_id, node_id, term) -> None:
+        if self._enabled:
+            self.metrics.inc("raftnode_campaign_skipped_total", (cluster_id, node_id))
+
+    def snapshot_rejected(
+        self, cluster_id, node_id, index, term, from_node
+    ) -> None:
+        if self._enabled:
+            self.metrics.inc("raftnode_snapshot_rejected_total", (cluster_id, node_id))
+
+    def replication_rejected(
+        self, cluster_id, node_id, log_index, log_term, from_node
+    ) -> None:
+        if self._enabled:
+            self.metrics.inc(
+                "raftnode_replication_rejected_total", (cluster_id, node_id)
+            )
+
+    def proposal_dropped(self, cluster_id, node_id, entries) -> None:
+        if self._enabled:
+            n = len(entries) if entries else 1
+            self.metrics.inc(
+                "raftnode_proposal_dropped_total", (cluster_id, node_id), n
+            )
+
+    def read_index_dropped(self, cluster_id, node_id) -> None:
+        if self._enabled:
+            self.metrics.inc(
+                "raftnode_read_index_dropped_total", (cluster_id, node_id)
+            )
+
+    def __getattr__(self, name):
+        def noop(*a, **k):
+            return None
+
+        return noop
+
+
+__all__ = ["MetricsRegistry", "RaftEventAggregator"]

@@ -1,0 +1,129 @@
+"""Pluggable logging indirection (cf. reference logger/logger.go:25-147).
+
+The reference routes every package's logging through an ILogger factory so
+embedding applications can redirect it. Here the same seam wraps stdlib
+logging: `set_logger_factory` swaps the backend for every named package
+logger already handed out (the reference's SetLoggerFactory has the same
+retroactive behavior via its wrapper indirection).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+
+
+class ILogger:
+    """cf. logger/logger.go:47 ILogger."""
+
+    def set_level(self, level: int) -> None:
+        raise NotImplementedError
+
+    def debugf(self, fmt: str, *args) -> None:
+        raise NotImplementedError
+
+    def infof(self, fmt: str, *args) -> None:
+        raise NotImplementedError
+
+    def warningf(self, fmt: str, *args) -> None:
+        raise NotImplementedError
+
+    def errorf(self, fmt: str, *args) -> None:
+        raise NotImplementedError
+
+    def panicf(self, fmt: str, *args) -> None:
+        raise NotImplementedError
+
+
+class StdLogger(ILogger):
+    """Default backend over the stdlib logging module
+    (the capnslog equivalent, cf. logger/capnslogger.go)."""
+
+    def __init__(self, pkg: str) -> None:
+        self._log = logging.getLogger(f"dragonboat_tpu.{pkg}")
+
+    def set_level(self, level: int) -> None:
+        self._log.setLevel(level)
+
+    def debugf(self, fmt: str, *args) -> None:
+        self._log.debug(fmt, *args)
+
+    def infof(self, fmt: str, *args) -> None:
+        self._log.info(fmt, *args)
+
+    def warningf(self, fmt: str, *args) -> None:
+        self._log.warning(fmt, *args)
+
+    def errorf(self, fmt: str, *args) -> None:
+        self._log.error(fmt, *args)
+
+    def panicf(self, fmt: str, *args) -> None:
+        msg = fmt % args if args else fmt
+        self._log.critical(msg)
+        raise RuntimeError(msg)
+
+
+class _Wrapped(ILogger):
+    """Stable handle whose backend can be swapped after the fact."""
+
+    def __init__(self, pkg: str, backend: ILogger) -> None:
+        self._pkg = pkg
+        self._backend = backend
+
+    def _swap(self, backend: ILogger) -> None:
+        self._backend = backend
+
+    def set_level(self, level: int) -> None:
+        self._backend.set_level(level)
+
+    def debugf(self, fmt: str, *args) -> None:
+        self._backend.debugf(fmt, *args)
+
+    def infof(self, fmt: str, *args) -> None:
+        self._backend.infof(fmt, *args)
+
+    def warningf(self, fmt: str, *args) -> None:
+        self._backend.warningf(fmt, *args)
+
+    def errorf(self, fmt: str, *args) -> None:
+        self._backend.errorf(fmt, *args)
+
+    def panicf(self, fmt: str, *args) -> None:
+        self._backend.panicf(fmt, *args)
+
+
+_mu = threading.Lock()
+_factory: Callable[[str], ILogger] = StdLogger
+_loggers: Dict[str, _Wrapped] = {}
+
+
+def get_logger(pkg: str) -> ILogger:
+    """Package-level logger; survives later set_logger_factory calls."""
+    with _mu:
+        w = _loggers.get(pkg)
+        if w is None:
+            w = _Wrapped(pkg, _factory(pkg))
+            _loggers[pkg] = w
+        return w
+
+
+def set_logger_factory(factory: Callable[[str], ILogger]) -> None:
+    """cf. logger.SetLoggerFactory — swaps the backend of every logger,
+    including ones already handed out."""
+    global _factory
+    with _mu:
+        _factory = factory
+        for pkg, w in _loggers.items():
+            w._swap(factory(pkg))
+
+
+__all__ = [
+    "ILogger", "StdLogger", "get_logger", "set_logger_factory",
+    "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG",
+]

@@ -18,6 +18,7 @@ from .config import Config, NodeHostConfig
 from .core.peer import PeerAddress
 from .engine.execengine import ExecEngine
 from .engine.node import Node
+from .events import MetricsRegistry, RaftEventAggregator
 from .engine.snapshotter import Snapshotter
 from .raftio import ErrNoBootstrapInfo, IMessageHandler
 from .requests import (
@@ -77,6 +78,13 @@ class NodeHost(IMessageHandler):
         self._nodes_mu = threading.RLock()
         self._nodes: Dict[int, Node] = {}
         self._stopped = threading.Event()
+        # --- events + metrics (cf. event.go:34-141)
+        self.metrics = MetricsRegistry()
+        self._event_aggregator = RaftEventAggregator(
+            self.metrics,
+            user_listener=cfg.raft_event_listener,
+            enable_metrics=cfg.enable_metrics,
+        )
         # --- directories
         if cfg.nodehost_dir:
             self._dir = os.path.join(
@@ -143,10 +151,20 @@ class NodeHost(IMessageHandler):
         self.engine.stop()
         self.transport.stop()
         self.logdb.close()
+        self._event_aggregator.stop()
         if self._tick_thread.is_alive():
             self._tick_thread.join(timeout=2)
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
+
+    def write_health_metrics(self, w) -> None:
+        """Prometheus text exposition of node + transport metrics
+        (cf. WriteHealthMetrics event.go:30-32)."""
+        self.metrics.write(w)
+        for name, v in sorted(self.transport.metrics().items()):
+            full = f"dragonboat_tpu_transport_{name}_total"
+            w.write(f"# TYPE {full} counter\n")
+            w.write(f"{full} {v:g}\n")
 
     # ------------------------------------------------------------ start paths
     def start_cluster(
@@ -202,7 +220,7 @@ class NodeHost(IMessageHandler):
             snapshotter=snapshotter,
             send_message=self._send_message,
             engine=self.engine,
-            event_listener=self.config.raft_event_listener,
+            event_listener=self._event_aggregator,
         )
         with self._nodes_mu:
             self._nodes[cluster_id] = node

@@ -33,12 +33,14 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _ensure_built() -> str:
+def _ensure_built(force: bool = False) -> str:
     src = os.path.abspath(os.path.join(_NATIVE_DIR, "walkv.cc"))
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and os.path.getmtime(
-            _LIB_PATH
-        ) >= os.path.getmtime(src):
+        if (
+            not force
+            and os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
+        ):
             return _LIB_PATH
         os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
         cmd = [
@@ -58,7 +60,11 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_ensure_built())
+    try:
+        lib = ctypes.CDLL(_ensure_built())
+    except OSError:
+        # a stale/foreign-arch library on disk: rebuild from source once
+        lib = ctypes.CDLL(_ensure_built(force=True))
     lib.walkv_open.restype = ctypes.c_void_p
     lib.walkv_open.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -188,7 +194,9 @@ class NativeWalKV(IKVStore):
             raise OSError(f"walkv_bulk_remove failed: rc={rc}")
 
     def compact_entries(self, fk: bytes, lk: bytes) -> None:
-        self._lib.walkv_maybe_compact(self._h, _COMPACT_THRESHOLD)
+        rc = self._lib.walkv_maybe_compact(self._h, _COMPACT_THRESHOLD)
+        if rc != 0:
+            raise OSError(f"walkv_maybe_compact failed: rc={rc}")
 
     def full_compaction(self) -> None:
         rc = self._lib.walkv_full_compaction(self._h)

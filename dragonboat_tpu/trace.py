@@ -1,0 +1,91 @@
+"""Sampled latency profiler for the execution engine hot loop.
+
+cf. reference trace.go:29-162: bounded percentile samples (p50/p99/p999)
+per pipeline stage, recorded every `sample_ratio` iterations so the
+steady-state cost is one time.monotonic() pair per stage only on sampled
+iterations, nothing otherwise. Dumped via logger at engine stop
+(cf. execengine.go:197-211).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class Sample:
+    """Bounded sample with cheap percentiles (cf. trace.go:29-96)."""
+
+    __slots__ = ("name", "_vals", "_cap")
+
+    def __init__(self, name: str, cap: int = 50_000) -> None:
+        self.name = name
+        self._vals: List[float] = []
+        self._cap = cap
+
+    def record(self, v: float) -> None:
+        if len(self._vals) < self._cap:
+            self._vals.append(v)
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def percentile(self, p: float) -> float:
+        if not self._vals:
+            return 0.0
+        s = sorted(self._vals)
+        k = min(len(s) - 1, max(0, int(p * len(s))))
+        return s[k]
+
+    def mean(self) -> float:
+        return sum(self._vals) / len(self._vals) if self._vals else 0.0
+
+    def report(self) -> str:
+        return (
+            f"{self.name}: n={len(self._vals)} mean={self.mean()*1e6:.1f}us "
+            f"p50={self.percentile(0.50)*1e6:.1f}us "
+            f"p99={self.percentile(0.99)*1e6:.1f}us "
+            f"p999={self.percentile(0.999)*1e6:.1f}us"
+        )
+
+
+STAGES = ("propose", "step", "fast_apply", "send", "save", "apply", "exec")
+
+
+class Profiler:
+    """Per-worker stage profiler (cf. trace.go:98-162 profiler; stages match
+    the reference's propose/step/save/cs/exec breakdown plus our apply)."""
+
+    def __init__(self, sample_ratio: int = 16) -> None:
+        self.ratio = max(1, sample_ratio)
+        self._iter = 0
+        self.sampling = False
+        self.samples: Dict[str, Sample] = {s: Sample(s) for s in STAGES}
+        self.batched_groups = Sample("batched_groups")
+        self._t0: Optional[float] = None
+
+    def new_iteration(self, n_groups: int = 0) -> None:
+        self._iter += 1
+        self.sampling = self._iter % self.ratio == 0
+        if self.sampling and n_groups:
+            self.batched_groups.record(float(n_groups))
+
+    def start(self) -> None:
+        if self.sampling:
+            self._t0 = time.monotonic()
+
+    def end(self, stage: str) -> None:
+        if self.sampling and self._t0 is not None:
+            self.samples[stage].record(time.monotonic() - self._t0)
+            self._t0 = None
+
+    def report(self) -> str:
+        lines = [s.report() for s in self.samples.values() if len(s)]
+        if len(self.batched_groups):
+            lines.append(
+                f"batched_groups: mean={self.batched_groups.mean():.1f} "
+                f"p99={self.batched_groups.percentile(0.99):.0f}"
+            )
+        return "\n".join(lines)
+
+
+__all__ = ["Sample", "Profiler", "STAGES"]

@@ -125,8 +125,7 @@ class WalKV {
     std::lock_guard<std::mutex> g(mu_);
     std::string buf;
     for (const auto& o : ops) AppendRec(buf, o);
-    if (WriteAll(fd_, buf.data(), buf.size()) != 0) return -2;
-    if (fsync_ && ::fsync(fd_) != 0) return -3;
+    if (AppendDurable(buf) != 0) return -2;
     for (const auto& o : ops) Apply(o);
     pending_compact_ += ops.size();
     return 0;
@@ -156,8 +155,7 @@ class WalKV {
     std::lock_guard<std::mutex> g(mu_);
     std::string buf;
     AppendRec(buf, o);
-    if (WriteAll(fd_, buf.data(), buf.size()) != 0) return -2;
-    if (fsync_ && ::fsync(fd_) != 0) return -3;
+    if (AppendDurable(buf) != 0) return -2;
     Apply(o);
     ++pending_compact_;
     return 0;
@@ -216,6 +214,21 @@ class WalKV {
   }
 
  private:
+  // Append + fsync as one durable unit. On any failure the file is
+  // truncated back to its pre-write length: a torn record left in place
+  // would otherwise make Replay() stop at it and silently discard every
+  // later acknowledged write.
+  int AppendDurable(const std::string& buf) {
+    off_t start = ::lseek(fd_, 0, SEEK_END);
+    if (start < 0) return -1;
+    if (WriteAll(fd_, buf.data(), buf.size()) != 0 ||
+        (fsync_ && ::fsync(fd_) != 0)) {
+      if (::ftruncate(fd_, start) == 0 && fsync_) ::fsync(fd_);
+      return -1;
+    }
+    return 0;
+  }
+
   static int WriteAll(int fd, const char* p, size_t n) {
     while (n > 0) {
       ssize_t w = ::write(fd, p, n);
