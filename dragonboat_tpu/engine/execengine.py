@@ -223,7 +223,9 @@ class ExecEngine:
         for node, ud in updates:
             if not ud.fast_apply:
                 node.apply_raft_update(ud)
+        prof.end("apply")
         # 5. window append, remaining sends, snapshot triggers, cursors
+        prof.start()
         for node, ud in updates:
             node.process_raft_update(ud)
             node.commit_raft_update(ud)

@@ -9,7 +9,6 @@ thread so a slow listener can't stall step workers).
 """
 from __future__ import annotations
 
-import queue
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -71,7 +70,12 @@ class RaftEventAggregator:
         self.metrics = metrics
         self._user = user_listener
         self._enabled = enable_metrics
-        self._q: "queue.Queue[Optional[LeaderInfo]]" = queue.Queue(maxsize=4096)
+        # Coalescing mailbox: only the LATEST LeaderInfo per (cluster, node)
+        # is kept, so a slow listener can never block a step worker or miss
+        # the final "leader is now X" update — intermediate churn collapses.
+        self._cv = threading.Condition()
+        self._pending: Dict[_LabelKey, LeaderInfo] = {}
+        self._stop = False
         self._thread: Optional[threading.Thread] = None
         if user_listener is not None:
             self._thread = threading.Thread(
@@ -81,19 +85,26 @@ class RaftEventAggregator:
 
     def stop(self) -> None:
         if self._thread is not None:
-            self._q.put(None)
+            with self._cv:
+                self._stop = True
+                self._cv.notify()
             self._thread.join(timeout=2)
             self._thread = None
 
     def _dispatch_main(self) -> None:
         while True:
-            info = self._q.get()
-            if info is None:
-                return
-            try:
-                self._user.leader_updated(info)
-            except Exception:
-                pass  # user listener errors must not kill the dispatcher
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._pending:
+                    return
+                batch = list(self._pending.values())
+                self._pending.clear()
+            for info in batch:
+                try:
+                    self._user.leader_updated(info)
+                except Exception:
+                    pass  # user listener errors must not kill the dispatcher
 
     # -- callbacks from the raft core (all on step-worker threads) ----------
     def leader_updated(self, cluster_id, node_id, leader_id, term) -> None:
@@ -103,15 +114,13 @@ class RaftEventAggregator:
             self.metrics.set_gauge("raftnode_leader_id", key, float(leader_id))
             self.metrics.set_gauge("raftnode_term", key, float(term))
         if self._user is not None:
-            try:
-                self._q.put_nowait(
-                    LeaderInfo(
-                        cluster_id=cluster_id, node_id=node_id,
-                        leader_id=leader_id, term=term,
-                    )
-                )
-            except queue.Full:
-                pass
+            info = LeaderInfo(
+                cluster_id=cluster_id, node_id=node_id,
+                leader_id=leader_id, term=term,
+            )
+            with self._cv:
+                self._pending[(cluster_id, node_id)] = info
+                self._cv.notify()
 
     def campaign_launched(self, cluster_id, node_id, term) -> None:
         if self._enabled:

@@ -1,0 +1,220 @@
+"""Linearizability checking of recorded client histories.
+
+The reference's chaos harness (external lni/drummer repo, methodology at
+docs/test.md:11-33) records client operation histories in Jepsen format and
+checks them with Knossos/porcupine. This module is the in-tree equivalent:
+a history recorder producing timestamped invoke/return intervals and a
+Wing&Gong-style checker (the porcupine algorithm: DFS over candidate
+linearization orders with (linearized-set, state) memoization, plus the
+standard treatment of unknown-outcome operations — a timed-out op may be
+linearized at any point after its invocation or dropped entirely).
+
+Generic over a sequential model; `kv_model`/`register_model` plus
+`partition_by_key` cover the KV histories the chaos tests record.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+INF = float("inf")
+
+# Sentinel output handed to Model.step for unknown-outcome operations —
+# the model must not constrain state transitions on it.
+UNKNOWN = object()
+
+
+@dataclass(slots=True)
+class Operation:
+    """One client operation with its real-time interval."""
+
+    client: int
+    input: Any
+    output: Any = None
+    invoke: float = 0.0
+    ret: float = INF  # INF => never returned (outcome unknown)
+    op_id: int = 0
+
+    @property
+    def completed(self) -> bool:
+        return self.ret != INF
+
+
+@dataclass
+class Model:
+    """Sequential specification.
+
+    init: () -> state
+    step: (state, input, output) -> (ok, new_state); for an op with unknown
+      output (ret=INF) the checker calls step with output=None and ok only
+      gates on preconditions.
+    """
+
+    init: Callable[[], Hashable]
+    step: Callable[[Hashable, Any, Any], Tuple[bool, Hashable]]
+
+
+class HistoryRecorder:
+    """Thread-safe Jepsen-style op log."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._ops: Dict[int, Operation] = {}
+        self._next = itertools.count()
+
+    def invoke(self, client: int, inp: Any) -> int:
+        op_id = next(self._next)
+        op = Operation(
+            client=client, input=inp, invoke=time.monotonic(), op_id=op_id
+        )
+        with self._mu:
+            self._ops[op_id] = op
+        return op_id
+
+    def complete(self, op_id: int, output: Any) -> None:
+        with self._mu:
+            op = self._ops[op_id]
+            op.output = output
+            op.ret = time.monotonic()
+
+    def fail(self, op_id: int) -> None:
+        """Definite failure: the op did NOT take effect; drop it."""
+        with self._mu:
+            self._ops.pop(op_id, None)
+
+    def unknown(self, op_id: int) -> None:
+        """Timeout/indeterminate: keep with ret=INF (may have taken effect)."""
+        pass  # the default state already encodes this
+
+    def history(self) -> List[Operation]:
+        with self._mu:
+            return sorted(self._ops.values(), key=lambda o: o.invoke)
+
+
+def check_linearizable(
+    model: Model, history: List[Operation], max_states: int = 2_000_000
+) -> bool:
+    """True iff `history` is linearizable w.r.t. `model`.
+
+    DFS over linearization prefixes. At each step any remaining op whose
+    invocation precedes the earliest return among remaining *completed* ops
+    may linearize next. Unknown-outcome ops may additionally be dropped
+    (never linearized). Memoizes (frozenset(linearized), state).
+    """
+    ops = list(history)
+    if not ops:
+        return True
+    all_ids = frozenset(op.op_id for op in ops)
+    by_id = {op.op_id: op for op in ops}
+    seen: set = set()
+    budget = [max_states]
+
+    def candidates(remaining: frozenset, state: Hashable):
+        """Yield (remaining', state') for every op that may linearize next."""
+        min_ret = min(by_id[i].ret for i in remaining)
+        for i in remaining:
+            op = by_id[i]
+            if op.invoke > min_ret:
+                continue  # some other remaining op fully precedes this one
+            if op.completed:
+                ok, ns = model.step(state, op.input, op.output)
+                if ok:
+                    yield remaining - {i}, ns
+            else:
+                # unknown outcome: "it happened" (output unconstrained,
+                # models receive the UNKNOWN sentinel) ...
+                ok, ns = model.step(state, op.input, UNKNOWN)
+                if ok:
+                    yield remaining - {i}, ns
+                # ... or "it never happened"
+                yield remaining - {i}, state
+
+    # iterative DFS (histories can be thousands of ops deep)
+    stack = [iter([(all_ids, model.init())])]
+    while stack:
+        nxt = next(stack[-1], None)
+        if nxt is None:
+            stack.pop()
+            continue
+        remaining, state = nxt
+        if not remaining:
+            return True
+        key = (remaining, state)
+        if key in seen:
+            continue
+        seen.add(key)
+        if budget[0] <= 0:
+            raise LincheckBudgetExceeded(max_states)
+        budget[0] -= 1
+        stack.append(candidates(remaining, state))
+    return False
+
+
+class LincheckBudgetExceeded(RuntimeError):
+    """Search exceeded max_states — result indeterminate, not a violation."""
+
+
+# ---------------------------------------------------------------- KV models
+# inputs: ("put", key, value) | ("get", key); output: None for put,
+# read value (or None) for get.
+
+def kv_model() -> Model:
+    def init() -> Hashable:
+        return ()
+
+    def step(state, inp, output):
+        d = dict(state)
+        if inp[0] == "put":
+            d[inp[1]] = inp[2]
+            return True, tuple(sorted(d.items()))
+        # get: unknown-outcome reads don't constrain the state
+        if output is UNKNOWN:
+            return True, state
+        return d.get(inp[1]) == output, state
+
+    return Model(init=init, step=step)
+
+
+def register_model() -> Model:
+    """Single-value register: input ("w", v) or ("r",), output read value."""
+
+    def init() -> Hashable:
+        return None
+
+    def step(state, inp, output):
+        if inp[0] == "w":
+            return True, inp[1]
+        if output is UNKNOWN:
+            return True, state
+        return state == output, state
+
+    return Model(init=init, step=step)
+
+
+def partition_by_key(history: List[Operation]) -> List[List[Operation]]:
+    """Split a KV history into independent per-key histories (each key is an
+    independent register, so the product check is equivalent and the DFS
+    stays tractable — the same optimization porcupine's KV model uses)."""
+    parts: Dict[Any, List[Operation]] = {}
+    for op in history:
+        parts.setdefault(op.input[1], []).append(op)
+    return list(parts.values())
+
+
+def check_kv_history(history: List[Operation], max_states: int = 2_000_000) -> bool:
+    """Convenience: per-key-partitioned KV linearizability check."""
+    model = kv_model()
+    for part in partition_by_key(history):
+        if not check_linearizable(model, part, max_states):
+            return False
+    return True
+
+
+__all__ = [
+    "Operation", "Model", "HistoryRecorder", "check_linearizable",
+    "check_kv_history", "kv_model", "register_model", "partition_by_key",
+    "LincheckBudgetExceeded", "UNKNOWN",
+]

@@ -460,6 +460,31 @@ class NodeHost(IMessageHandler):
             )
         return out
 
+    # ----------------------------------------------------- chaos-test knobs
+    # cf. monkey.go:90-198 (build-tag-gated in the reference; here plain
+    # methods — they cost nothing unless used)
+    def set_partitioned(self, partitioned: bool) -> None:
+        """Partition mode: drop ALL inbound and outbound raft traffic
+        (cf. monkey.go:169-198)."""
+        self._partitioned = partitioned
+
+    def is_partitioned(self) -> bool:
+        return self._partitioned
+
+    def get_sm_hash(self, cluster_id: int) -> int:
+        """Content digest of the node's SM for cross-replica equality checks
+        (cf. monkey.go:90-142)."""
+        return self._get_node(cluster_id).sm.get_hash()
+
+    def get_session_hash(self, cluster_id: int) -> int:
+        return self._get_node(cluster_id).sm.get_session_hash()
+
+    def get_membership_hash(self, cluster_id: int) -> int:
+        return self._get_node(cluster_id).sm.get_membership_hash()
+
+    def get_applied_index(self, cluster_id: int) -> int:
+        return self._get_node(cluster_id).sm.last_applied_index()
+
     # ------------------------------------------------------------- transport
     def _send_message(self, m: Message) -> None:
         if self._partitioned:

@@ -48,7 +48,7 @@ class Sample:
         )
 
 
-STAGES = ("propose", "step", "fast_apply", "send", "save", "apply", "exec")
+STAGES = ("step", "fast_apply", "send", "save", "apply", "exec")
 
 
 class Profiler:

@@ -217,13 +217,20 @@ class WalKV {
   // Append + fsync as one durable unit. On any failure the file is
   // truncated back to its pre-write length: a torn record left in place
   // would otherwise make Replay() stop at it and silently discard every
-  // later acknowledged write.
+  // later acknowledged write. If the truncate-back itself fails the store
+  // is poisoned (failed_): further writes would land after the torn
+  // record and be stranded, so they must be refused.
   int AppendDurable(const std::string& buf) {
+    if (failed_) return -10;
     off_t start = ::lseek(fd_, 0, SEEK_END);
     if (start < 0) return -1;
     if (WriteAll(fd_, buf.data(), buf.size()) != 0 ||
         (fsync_ && ::fsync(fd_) != 0)) {
-      if (::ftruncate(fd_, start) == 0 && fsync_) ::fsync(fd_);
+      if (::ftruncate(fd_, start) == 0) {
+        if (fsync_) ::fsync(fd_);
+      } else {
+        failed_ = true;
+      }
       return -1;
     }
     return 0;
@@ -314,6 +321,7 @@ class WalKV {
 
   std::string dir_;
   bool fsync_;
+  bool failed_ = false;  // torn tail could not be truncated away
   int fd_ = -1;
   std::map<std::string, std::string> table_;
   uint64_t pending_compact_ = 0;

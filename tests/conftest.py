@@ -21,3 +21,9 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover - plugin absent outside this image
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration/chaos tests"
+    )

@@ -1,0 +1,211 @@
+"""Chaos-mode integration test (in-tree drummer-lite).
+
+Mirrors the reference's monkey-test methodology (docs/test.md:11-33,
+monkey.go): a 3-host loopback cluster runs client traffic while faults are
+injected — transport message drops, full partitions of one host at a time,
+and a NodeHost kill+restart from its durable dir. Invariants checked at
+the end (after fault injection stops and the cluster settles):
+
+  1. no linearizability violation in the recorded client history
+  2. all replicas' state machines converge to the same content hash
+  3. applied indexes converge
+
+cf. SURVEY.md §4: "no linearizability violation, SMs in sync".
+"""
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.lincheck import HistoryRecorder, check_kv_history
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.requests import RequestError
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+CLUSTER = 1
+HOSTS = (1, 2, 3)
+KEYS = [f"k{i}" for i in range(4)]
+
+
+class HashKV(IStateMachine):
+    """KV SM with a content hash (cf. internal/tests/kvtest.go sans delays)."""
+
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=1)
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def get_hash(self):
+        blob = json.dumps(sorted(self.d.items())).encode()
+        import zlib
+
+        return zlib.crc32(blob)
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+def _mk_host(nid, reg, tmp):
+    cfg = NodeHostConfig(
+        deployment_id=3, rtt_millisecond=5,
+        nodehost_dir=f"{tmp}/h{nid}",
+        raft_address=f"c{nid}:1",
+        raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+    )
+    nh = NodeHost(cfg)
+    members = {h: f"c{h}:1" for h in HOSTS}
+    nh.start_cluster(
+        members, False, lambda c, n: HashKV(),
+        Config(
+            cluster_id=CLUSTER, node_id=nid, election_rtt=10, heartbeat_rtt=2,
+            snapshot_entries=50, compaction_overhead=10,
+        ),
+    )
+    return nh
+
+
+def _find_leader(hosts, deadline_s=20):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for nid, nh in list(hosts.items()):
+            if nh is None:
+                continue
+            try:
+                lid, ok = nh.get_leader_id(CLUSTER)
+            except Exception:
+                continue
+            if ok and lid == nid and not nh.is_partitioned():
+                return nid
+        time.sleep(0.02)
+    return None
+
+
+@pytest.mark.slow
+def test_chaos_linearizable_and_converged(tmp_path):
+    rng = random.Random(0xD5A60)
+    reg = _Registry()
+    hosts = {nid: _mk_host(nid, reg, str(tmp_path)) for nid in HOSTS}
+    rec = HistoryRecorder()
+    stop = threading.Event()
+    seq = [0]
+    seq_mu = threading.Lock()
+
+    def client_main(client_id):
+        while not stop.is_set():
+            leader = _find_leader(hosts, deadline_s=5)
+            if leader is None:
+                continue
+            nh = hosts.get(leader)
+            if nh is None:
+                continue
+            key = rng.choice(KEYS)
+            if rng.random() < 0.6:
+                with seq_mu:
+                    seq[0] += 1
+                    val = f"v{seq[0]}"
+                op_id = rec.invoke(client_id, ("put", key, val))
+                try:
+                    s = nh.get_noop_session(CLUSTER)
+                    nh.sync_propose(s, f"{key}={val}".encode(), timeout_s=2.0)
+                    rec.complete(op_id, None)
+                except (RequestError, Exception):
+                    rec.unknown(op_id)  # may or may not have applied
+            else:
+                op_id = rec.invoke(client_id, ("get", key))
+                try:
+                    v = nh.sync_read(CLUSTER, key, timeout_s=2.0)
+                    rec.complete(op_id, v)
+                except (RequestError, Exception):
+                    rec.fail(op_id)  # reads have no side effect: drop
+            time.sleep(rng.random() * 0.01)
+
+    clients = [
+        threading.Thread(target=client_main, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in clients:
+        t.start()
+
+    # -------- fault injection: drops, partitions, kill+restart ------------
+    t_end = time.time() + 12
+    while time.time() < t_end:
+        fault = rng.choice(["partition", "drop", "restart", "none"])
+        victim = rng.choice(HOSTS)
+        nh = hosts.get(victim)
+        if nh is None:
+            continue
+        if fault == "partition":
+            nh.set_partitioned(True)
+            time.sleep(rng.uniform(0.3, 0.8))
+            nh2 = hosts.get(victim)
+            if nh2 is not None:
+                nh2.set_partitioned(False)
+        elif fault == "drop":
+            # drop ~30% of outbound batches for a while
+            nh.transport.set_pre_send_batch_hook(
+                lambda batch: rng.random() > 0.3
+            )
+            time.sleep(rng.uniform(0.3, 0.8))
+            nh2 = hosts.get(victim)
+            if nh2 is not None:
+                nh2.transport.set_pre_send_batch_hook(None)
+        elif fault == "restart":
+            hosts[victim] = None
+            nh.stop()
+            time.sleep(rng.uniform(0.1, 0.3))
+            hosts[victim] = _mk_host(victim, reg, str(tmp_path))
+        else:
+            time.sleep(0.3)
+
+    # -------- settle & verify --------------------------------------------
+    stop.set()
+    for t in clients:
+        t.join(timeout=5)
+    for nid in HOSTS:
+        if hosts[nid] is not None:
+            hosts[nid].set_partitioned(False)
+            hosts[nid].transport.set_pre_send_batch_hook(None)
+        else:
+            hosts[nid] = _mk_host(nid, reg, str(tmp_path))
+
+    leader = _find_leader(hosts, deadline_s=30)
+    assert leader is not None, "cluster did not recover a leader"
+    # one final write forces convergence of the commit index
+    s = hosts[leader].get_noop_session(CLUSTER)
+    hosts[leader].sync_propose(s, b"final=done", timeout_s=10.0)
+
+    # wait for all replicas to apply to the same index
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        idx = {nid: hosts[nid].get_applied_index(CLUSTER) for nid in HOSTS}
+        if len(set(idx.values())) == 1:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"applied indexes never converged: {idx}")
+
+    hashes = {nid: hosts[nid].get_sm_hash(CLUSTER) for nid in HOSTS}
+    assert len(set(hashes.values())) == 1, f"replica SMs diverged: {hashes}"
+
+    history = rec.history()
+    n_ops = len(history)
+    assert n_ops > 20, f"chaos run produced too few ops ({n_ops})"
+    assert check_kv_history(history, max_states=5_000_000), (
+        "linearizability violation in recorded history"
+    )
+
+    for nh in hosts.values():
+        nh.stop()

@@ -1,0 +1,156 @@
+"""Linearizability checker unit tests — known-good and known-bad histories
+(the checker must catch violations, not just bless everything)."""
+import pytest
+
+from dragonboat_tpu.lincheck import (
+    HistoryRecorder,
+    LincheckBudgetExceeded,
+    Model,
+    Operation,
+    check_kv_history,
+    check_linearizable,
+    kv_model,
+    register_model,
+)
+
+
+def op(client, inp, out, inv, ret):
+    o = Operation(client=client, input=inp, output=out, invoke=inv, ret=ret)
+    o.op_id = id(o)
+    return o
+
+
+def test_sequential_register_ok():
+    h = [
+        op(0, ("w", 1), None, 0, 1),
+        op(0, ("r",), 1, 2, 3),
+        op(0, ("w", 2), None, 4, 5),
+        op(0, ("r",), 2, 6, 7),
+    ]
+    assert check_linearizable(register_model(), h)
+
+
+def test_stale_read_rejected():
+    h = [
+        op(0, ("w", 1), None, 0, 1),
+        op(0, ("w", 2), None, 2, 3),
+        op(1, ("r",), 1, 4, 5),  # reads overwritten value AFTER w2 returned
+    ]
+    assert not check_linearizable(register_model(), h)
+
+
+def test_concurrent_read_may_see_either_value():
+    # read overlaps the write: both old and new value are linearizable
+    h_new = [
+        op(0, ("w", 1), None, 0, 1),
+        op(0, ("w", 2), None, 2, 6),
+        op(1, ("r",), 2, 3, 4),
+    ]
+    h_old = [
+        op(0, ("w", 1), None, 0, 1),
+        op(0, ("w", 2), None, 2, 6),
+        op(1, ("r",), 1, 3, 4),
+    ]
+    assert check_linearizable(register_model(), h_new)
+    assert check_linearizable(register_model(), h_old)
+
+
+def test_read_from_the_future_rejected():
+    # read returns a value whose write is invoked strictly later
+    h = [
+        op(0, ("r",), 9, 0, 1),
+        op(1, ("w", 9), None, 2, 3),
+    ]
+    assert not check_linearizable(register_model(), h)
+
+
+def test_unknown_outcome_write_may_or_may_not_apply():
+    # timed-out write; later read sees it => must linearize it
+    h1 = [
+        op(0, ("w", 1), None, 0, 1),
+        op(1, ("w", 2), None, 2, float("inf")),  # unknown
+        op(0, ("r",), 2, 5, 6),
+    ]
+    # ...or the read still sees the old value => write never happened (yet)
+    h2 = [
+        op(0, ("w", 1), None, 0, 1),
+        op(1, ("w", 2), None, 2, float("inf")),
+        op(0, ("r",), 1, 5, 6),
+    ]
+    assert check_linearizable(register_model(), h1)
+    assert check_linearizable(register_model(), h2)
+
+
+def test_nonoverlapping_reads_cannot_flipflop():
+    # two sequential reads around nothing: second can't resurrect older value
+    h = [
+        op(0, ("w", 1), None, 0, 1),
+        op(1, ("w", 2), None, 2, 3),
+        op(2, ("r",), 2, 4, 5),
+        op(2, ("r",), 1, 6, 7),  # older value after newer was read
+    ]
+    assert not check_linearizable(register_model(), h)
+
+
+def test_kv_history_partitions_by_key():
+    h = [
+        op(0, ("put", "a", 1), None, 0, 1),
+        op(0, ("put", "b", 9), None, 0.5, 1.5),
+        op(1, ("get", "a"), 1, 2, 3),
+        op(1, ("get", "b"), 9, 2, 3),
+    ]
+    assert check_kv_history(h)
+    bad = h + [op(2, ("get", "a"), 777, 10, 11)]
+    assert not check_kv_history(bad)
+
+
+def test_recorder_roundtrip():
+    rec = HistoryRecorder()
+    a = rec.invoke(1, ("put", "x", 1))
+    rec.complete(a, None)
+    b = rec.invoke(1, ("get", "x"))
+    rec.complete(b, 1)
+    c = rec.invoke(2, ("put", "x", 2))
+    rec.unknown(c)  # timeout: stays with ret=INF
+    d = rec.invoke(2, ("put", "x", 3))
+    rec.fail(d)  # definite rejection: dropped
+    h = rec.history()
+    assert len(h) == 3
+    assert h[0].invoke <= h[1].invoke <= h[2].invoke
+    assert not h[2].completed
+    assert check_kv_history(h)
+
+
+def test_budget_exceeded_raises():
+    # big all-concurrent UNSATISFIABLE history (read of a never-written
+    # value): the exhaustive refutation must abort on budget, not hang
+    h = [op(i, ("w", i), None, 0, 100) for i in range(12)]
+    h.append(op(99, ("r",), 999, 0, 100))
+    with pytest.raises(LincheckBudgetExceeded):
+        check_linearizable(register_model(), h, max_states=50)
+
+
+def test_checker_respects_model_preconditions():
+    # a model where "inc" only applies when state is even; odd-state inc is
+    # rejected => history needs correct interleaving
+    def init():
+        return 0
+
+    def step(state, inp, output):
+        if inp == "inc":
+            return state % 2 == 0, state + 1
+        if inp == "odd-inc":
+            return state % 2 == 1, state + 1
+        return True, state
+
+    m = Model(init=init, step=step)
+    ok = [
+        op(0, "inc", None, 0, 10),
+        op(1, "odd-inc", None, 0, 10),
+    ]
+    assert check_linearizable(m, ok)
+    bad = [
+        op(0, "odd-inc", None, 0, 1),  # returns before inc is invoked
+        op(1, "inc", None, 2, 3),
+    ]
+    assert not check_linearizable(m, bad)
