@@ -49,8 +49,10 @@ class Model:
 
     init: () -> state
     step: (state, input, output) -> (ok, new_state); for an op with unknown
-      output (ret=INF) the checker calls step with output=None and ok only
-      gates on preconditions.
+      outcome (ret=INF) the checker calls step with the UNKNOWN sentinel as
+      output — models must not constrain the transition on it (check
+      `output is UNKNOWN`, never `output is None`: None is a legitimate
+      completed result, e.g. a get of an absent key).
     """
 
     init: Callable[[], Hashable]

@@ -197,6 +197,9 @@ class WalKV {
     if (fd_ < 0) return -5;
     if (fsync_ && ::fsync(fd_) != 0) return -6;
     pending_compact_ = 0;
+    // the O_TRUNC reopen removed any torn tail, so a poisoned store is
+    // safe to write again
+    failed_ = false;
     return 0;
   }
 

@@ -1,0 +1,380 @@
+"""Ported etcd/raft conformance scenarios against the scalar core.
+
+The reference vendors etcd's raft tests to guarantee corner-case parity
+(internal/raft/raft_etcd_test.go, raft_etcd_paper_test.go — docs/test.md:4).
+These are the highest-value scenarios re-expressed against our scalar core
+through the same message-level interface; each test cites the etcd test or
+Raft paper/thesis section it validates.
+"""
+import random
+
+import pytest
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import InMemLogDB
+from dragonboat_tpu.core.raft import Raft, RaftNodeState
+from dragonboat_tpu.types import (
+    Entry,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+)
+
+from tests.raft_harness import Network, make_cluster, new_test_raft
+
+
+def tick_until_election(r: Raft) -> None:
+    for _ in range(2 * r.election_timeout):
+        r.tick()
+
+MT = MessageType
+F, C, L = RaftNodeState.FOLLOWER, RaftNodeState.CANDIDATE, RaftNodeState.LEADER
+
+
+def logdb_with_terms(*terms: int) -> InMemLogDB:
+    """A stub log whose entry i (1-based) has term terms[i-1]
+    (the etcd-test idiom of seeding divergent logs)."""
+    db = InMemLogDB()
+    db.append([Entry(index=i + 1, term=t) for i, t in enumerate(terms)])
+    return db
+
+
+def terms_of(r: Raft):
+    first, last = r.log.first_index(), r.log.last_index()
+    return [r.log.term(i) for i in range(first, last + 1)]
+
+
+# ---------------------------------------------------------------------------
+# Paper figure 7 / etcd TestLeaderSyncFollowerLog: a newly elected leader
+# reconciles every divergent follower log shape.
+# ---------------------------------------------------------------------------
+LEADER_TERMS = (1, 1, 1, 4, 4, 5, 5, 6, 6, 6)
+FOLLOWER_SHAPES = [
+    (1, 1, 1, 4, 4, 5, 5, 6, 6),               # (a) missing entries
+    (1, 1, 1, 4),                              # (b) far behind
+    (1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 6),         # (c) extra uncommitted
+    (1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 7, 7),      # (d) extra higher-term
+    (1, 1, 1, 4, 4, 4, 4),                     # (e) conflicting tail
+    (1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 3),         # (f) long conflicting tail
+]
+
+
+@pytest.mark.parametrize("shape", FOLLOWER_SHAPES, ids="abcdef")
+def test_leader_sync_follower_log(shape):
+    db1 = logdb_with_terms(*LEADER_TERMS)
+    db1.set_state(State(term=6, vote=1))
+    db2 = logdb_with_terms(*shape)
+    db2.set_state(State(term=max(shape)))
+    r1 = new_test_raft(1, [1, 2, 3], logdb=db1)
+    r2 = new_test_raft(2, [1, 2, 3], logdb=db2)
+    r3 = new_test_raft(3, [1, 2, 3], logdb=logdb_with_terms(*LEADER_TERMS))
+    nt = Network({1: r1, 2: r2, 3: r3})
+    nt.elect(1)
+    assert r1.state == L
+    # election appended a noop at the new term; replication must rewrite the
+    # follower to exactly the leader's log
+    nt.propose(1, b"sync")
+    assert terms_of(r2) == terms_of(r1)
+    assert r2.log.committed == r1.log.committed
+
+
+# ---------------------------------------------------------------------------
+# etcd TestCommit: quorum-match + current-term-only commit matrix (§5.4.2)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "matches,log_terms,term,want",
+    [
+        # single voter
+        ([1], (1,), 1, 1),
+        ([1], (1,), 2, 0),       # entry not from current term (§5.4.2)
+        ([2], (1, 2), 2, 2),
+        ([1], (2,), 2, 1),
+        # two voters: quorum = BOTH, so the min match is decisive
+        ([2, 1], (1, 2), 2, 0),  # quorum index 1 has old term -> no commit
+        ([2, 2], (1, 2), 2, 2),
+        ([2, 1], (1, 1), 2, 0),
+        # three voters (self is index 0): quorum = 2nd-highest match
+        ([3, 2, 1], (1, 2, 3), 3, 0),  # quorum idx 2, term(2)=2 != 3
+        ([3, 3, 1], (1, 2, 3), 3, 3),  # quorum idx 3, current term
+    ],
+)
+def test_commit_matrix(matches, log_terms, term, want):
+    db = logdb_with_terms(*log_terms)
+    db.set_state(State(term=term))
+    peers = list(range(1, len(matches) + 1))
+    r = new_test_raft(1, peers, logdb=db)
+    r.term = term
+    r.state = L
+    r.leader_id = 1
+    for nid, m in zip(peers, matches):
+        r.remotes[nid].match = m
+        r.remotes[nid].next = m + 1
+    r.try_commit()
+    assert r.log.committed == want
+
+
+def test_commit_only_current_term_explicit():
+    """etcd TestCommit core case: quorum match on an old-term entry does not
+    commit it; a current-term entry at the same quorum does."""
+    db = logdb_with_terms(1, 2)
+    db.set_state(State(term=2))
+    r = new_test_raft(1, [1, 2], logdb=db)
+    r.term = 2
+    r.state = L
+    r.remotes[1].match = 2  # leader's own progress
+    r.remotes[1].next = 3
+    r.remotes[2].match = 1
+    r.try_commit()
+    assert r.log.committed == 0  # index 1 has term 1 != current term 2
+    r.remotes[2].match = 2
+    r.try_commit()
+    assert r.log.committed == 2  # commits both (log matching)
+
+
+# ---------------------------------------------------------------------------
+# etcd TestRecvMsgVote / TestVoter: the grant/reject matrix on log
+# up-to-dateness (§5.4.1)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "my_terms,cand_log_term,cand_log_index,grant",
+    [
+        # empty local log: grant anything
+        ((), 0, 0, True),
+        ((), 1, 1, True),
+        # local log [(1,1)]
+        ((1,), 0, 0, False),   # candidate log older term
+        ((1,), 1, 0, False),   # same term, shorter
+        ((1,), 1, 1, True),    # identical
+        ((1,), 1, 2, True),    # same term, longer
+        ((1,), 2, 1, True),    # higher last term wins even if shorter
+        # local log [(1,1),(2,2)]
+        ((1, 2), 1, 1, False),
+        ((1, 2), 1, 3, False),  # longer but lower last term loses
+        ((1, 2), 2, 1, False),  # same last term, shorter
+        ((1, 2), 2, 2, True),
+        ((1, 2), 3, 1, True),
+    ],
+)
+def test_vote_grant_matrix(my_terms, cand_log_term, cand_log_index, grant):
+    db = logdb_with_terms(*my_terms)
+    r = new_test_raft(1, [1, 2], logdb=db)
+    r.handle(
+        Message(
+            type=MT.REQUEST_VOTE, from_=2, to=1, term=3,
+            log_term=cand_log_term, log_index=cand_log_index,
+        )
+    )
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP][-1]
+    assert resp.reject != grant
+
+
+# ---------------------------------------------------------------------------
+# etcd TestDuelingCandidates
+# ---------------------------------------------------------------------------
+def test_dueling_candidates():
+    nt = make_cluster(3)
+    nt.drop(1, 3)
+    nt.drop(3, 1)
+    nt.elect(1)   # 1 wins with {1,2}
+    nt.elect(3)   # 3 campaigns at term 2; 2's log has 1's noop so vote denied
+    assert nt.rafts[1].state == L
+    assert nt.rafts[3].state == C
+    nt.heal()
+    # 3 campaigns again at a higher term; its log is stale so it still can't
+    # win, but the higher term forces 1 to step down and re-elect
+    nt.elect(3)
+    assert nt.rafts[3].state != L
+    assert nt.rafts[1].log.last_index() >= 1
+
+
+# ---------------------------------------------------------------------------
+# etcd TestOldMessages: stale-term replicate after re-election is ignored
+# ---------------------------------------------------------------------------
+def test_old_messages_ignored():
+    nt = make_cluster(3)
+    nt.elect(1)
+    nt.elect(2)
+    nt.elect(1)  # term 3, leader 1 again
+    r1 = nt.rafts[1]
+    assert r1.state == L and r1.term == 3
+    last = r1.log.last_index()
+    # replay an old term-2 replicate carrying a conflicting entry
+    nt.send(
+        Message(
+            type=MT.REPLICATE, from_=2, to=1, term=2,
+            log_index=0, log_term=0, entries=[Entry(index=last + 1, term=2)],
+        )
+    )
+    assert r1.term == 3 and r1.state == L
+    assert r1.log.last_index() == last  # nothing appended
+
+
+# ---------------------------------------------------------------------------
+# etcd TestProposalByProxy
+# ---------------------------------------------------------------------------
+def test_proposal_by_proxy_commits_everywhere():
+    nt = make_cluster(3)
+    nt.elect(1)
+    before = nt.rafts[1].log.committed
+    nt.propose(2, b"proxied")  # follower forwards to leader
+    for r in nt.rafts.values():
+        assert r.log.committed == before + 1
+    ents = nt.rafts[3].log.entries(nt.rafts[3].log.committed, 1 << 20)
+    assert ents[0].cmd == b"proxied"
+
+
+# ---------------------------------------------------------------------------
+# etcd TestAllServerStepdown: every state steps down on higher-term messages
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("start_state", ["follower", "candidate", "leader"])
+@pytest.mark.parametrize("mtype", [MT.REQUEST_VOTE, MT.REPLICATE])
+def test_all_server_stepdown(start_state, mtype):
+    r = new_test_raft(1, [1, 2, 3])
+    if start_state == "candidate":
+        tick_until_election(r)
+        assert r.state == C
+    elif start_state == "leader":
+        tick_until_election(r)
+        r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=2, to=1,
+                         term=r.term, reject=False))
+        assert r.state == L
+    r.msgs = []
+    high = r.term + 10
+    r.handle(Message(type=mtype, from_=2, to=1, term=high,
+                     log_index=10, log_term=high))
+    assert r.state == F
+    assert r.term == high
+
+
+# ---------------------------------------------------------------------------
+# etcd TestBcastBeat / paper §5.2: leader heartbeats on its timeout
+# ---------------------------------------------------------------------------
+def test_leader_broadcasts_heartbeat_on_timeout():
+    nt = make_cluster(3, election=10, heartbeat=2)
+    nt.elect(1)
+    r1 = nt.rafts[1]
+    nt.collect()  # drain
+    for _ in range(2):
+        r1.tick()
+    beats = [m for m in r1.msgs if m.type == MT.HEARTBEAT]
+    assert {m.to for m in beats} == {2, 3}
+
+
+# ---------------------------------------------------------------------------
+# paper §5.2: candidate starts a NEW election (higher term) after timeout
+# ---------------------------------------------------------------------------
+def test_candidate_restarts_election_with_higher_term():
+    r = new_test_raft(1, [1, 2, 3], seed=7)
+    tick_until_election(r)
+    assert r.state == C and r.term == 1
+    tick_until_election(r)
+    assert r.state == C and r.term == 2
+    reqs = [m for m in r.msgs if m.type == MT.REQUEST_VOTE and m.term == 2]
+    assert {m.to for m in reqs} == {2, 3}
+
+
+# ---------------------------------------------------------------------------
+# paper §5.2 / etcd TestFollowerElectionTimeoutNonconflict: randomized
+# timeouts de-synchronize elections
+# ---------------------------------------------------------------------------
+def test_randomized_election_timeouts_differ():
+    timeouts = set()
+    for seed in range(8):
+        r = new_test_raft(1, [1, 2, 3], seed=seed)
+        n = 0
+        while r.state == F:
+            r.tick()
+            n += 1
+        timeouts.add(n)
+    assert len(timeouts) > 1, "all seeds timed out identically"
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderIncreaseNext: optimistic next after sending entries
+# ---------------------------------------------------------------------------
+def test_leader_optimistic_next_index():
+    nt = make_cluster(3)
+    nt.elect(1)
+    r1 = nt.rafts[1]
+    for i in range(3):
+        nt.propose(1, b"p%d" % i)
+    assert r1.remotes[2].next == r1.log.last_index() + 1
+    assert r1.remotes[2].match == r1.log.last_index()
+
+
+# ---------------------------------------------------------------------------
+# etcd TestVoteRequest: campaign carries the candidate's last log position
+# ---------------------------------------------------------------------------
+def test_vote_request_carries_last_log_position():
+    db = logdb_with_terms(1, 1, 2)
+    db.set_state(State(term=2))
+    r = new_test_raft(1, [1, 2], logdb=db)
+    tick_until_election(r)
+    req = [m for m in r.msgs if m.type == MT.REQUEST_VOTE][-1]
+    assert req.log_index == 3
+    assert req.log_term == 2
+    assert req.term == 3
+
+
+# ---------------------------------------------------------------------------
+# etcd TestRestore: InstallSnapshot rebuilds log + membership
+# ---------------------------------------------------------------------------
+def test_install_snapshot_restores_follower():
+    r = new_test_raft(1, [1, 2], seed=1)
+    ss = Snapshot(
+        index=11, term=11,
+        membership=Membership(addresses={1: "a1", 2: "a2", 3: "a3"}),
+    )
+    r.handle(
+        Message(type=MT.INSTALL_SNAPSHOT, from_=2, to=1, term=11, snapshot=ss)
+    )
+    assert r.log.committed == 11
+    assert r.log.term(11) == 11
+    # remotes rebuild via the host-driven SnapshotReceived message AFTER the
+    # SM recovered (reference raft.go:1566-1568 handleRestoreRemote; the
+    # node runtime sends it from the snapshot worker)
+    r.handle(
+        Message(type=MT.SNAPSHOT_RECEIVED, from_=1, to=1, term=11, snapshot=ss)
+    )
+    assert set(r.remotes) == {1, 2, 3}
+    # re-delivering the same snapshot is a no-op ack (etcd TestRestoreIgnores)
+    r.msgs = []
+    r.handle(
+        Message(type=MT.INSTALL_SNAPSHOT, from_=2, to=1, term=11, snapshot=ss)
+    )
+    resp = [m for m in r.msgs if m.type == MT.REPLICATE_RESP][-1]
+    assert resp.log_index == 11
+
+
+# ---------------------------------------------------------------------------
+# etcd TestProvideSnap / reference raft.go:774-785: a follower whose needed
+# entries were compacted away gets an InstallSnapshot instead
+# ---------------------------------------------------------------------------
+def test_slow_follower_triggers_snapshot_send():
+    db = logdb_with_terms(1, 1, 1, 1, 1)
+    db.set_state(State(term=1, commit=3))
+    db.create_snapshot(
+        Snapshot(index=3, term=1,
+                 membership=Membership(addresses={1: "a", 2: "b"}))
+    )
+    db.compact(3)  # entries <= 3 unavailable
+    r = new_test_raft(1, [1, 2], logdb=db)
+    r.state = C  # campaign would bump the term; force the transition
+    r.term = 1
+    r.become_leader()
+    assert r.state == L
+    r.msgs = []
+    # follower far behind: next=1 is compacted away. The fallback only fires
+    # for ACTIVE remotes (reference raft.go:776-780 skips inactive ones —
+    # also conformance-checked below)
+    r.remotes[2].match = 0
+    r.remotes[2].next = 1
+    r.broadcast_replicate_message()
+    assert [m for m in r.msgs if m.type == MT.INSTALL_SNAPSHOT] == []
+    r.remotes[2].set_active()
+    r.broadcast_replicate_message()
+    snaps = [m for m in r.msgs if m.type == MT.INSTALL_SNAPSHOT]
+    assert len(snaps) == 1
+    assert snaps[0].snapshot.index == 3
