@@ -24,6 +24,9 @@ from ..types import Membership, Snapshot, Update
 
 SNAPSHOTS_TO_KEEP = 3
 GENERATING_SUFFIX = ".generating"
+# metadata record written into exported snapshot dirs (cf. the reference's
+# server.SnapshotMetadataFilename "snapshot.metadata")
+SNAPSHOT_METADATA_FILENAME = "snapshot.metadata"
 RECEIVING_SUFFIX = ".receiving"
 
 
@@ -126,6 +129,7 @@ class Snapshotter:
             membership=meta.membership,
             files=wire_files,
             cluster_id=self.cluster_id,
+            type=header.smtype,
             on_disk_index=meta.on_disk_index,
         )
         return ss, tmp
@@ -136,8 +140,35 @@ class Snapshotter:
         tmp = self._tmp_dir(ss.index)
         final = self._final_dir(ss.index)
         if req is not None and req.is_exported():
-            # exported snapshots move to the user path instead
+            # exported snapshots move to the user path instead, with a
+            # metadata record so tools.import_snapshot can rebuild the
+            # Snapshot record (cf. server.SnapshotMetadataFilename). The
+            # metadata is written INSIDE the temp dir so the rename below is
+            # the single crash-atomic commit point; all recorded paths are
+            # rebased onto the post-rename destination.
+            import dataclasses
+
+            from .. import codec
+
             dst = os.path.join(req.path, os.path.basename(final))
+            meta_ss = dataclasses.replace(
+                ss,
+                filepath=os.path.join(dst, os.path.basename(ss.filepath)),
+                files=[
+                    dataclasses.replace(
+                        f,
+                        filepath=os.path.join(
+                            dst, os.path.basename(f.filepath)
+                        ),
+                    )
+                    for f in ss.files
+                ],
+            )
+            mpath = os.path.join(tmp, SNAPSHOT_METADATA_FILENAME)
+            with open(mpath, "wb") as f:
+                f.write(codec.encode_snapshot(meta_ss))
+                f.flush()
+                os.fsync(f.fileno())
             os.rename(tmp, dst)
             return
         with self._mu:

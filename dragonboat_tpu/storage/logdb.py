@@ -276,12 +276,17 @@ class ShardedLogDB(ILogDB):
         (cf. rdb.go:208-233 importSnapshot)."""
         cid = ss.cluster_id
         sh = self._shard(cid)
-        # delete old snapshots + entries, write new state + snapshot record
+        # delete old snapshots + entries, write new bootstrap (join mode,
+        # like the reference's importSnapshot) + state + snapshot record
         wb = WriteBatch()
         fk, lk = keys.snapshot_range(cid, node_id, 0, 2**63)
         wb.delete_range(fk, lk)
         efk, elk = keys.entry_range(cid, node_id, 0, 2**63)
         wb.delete_range(efk, elk)
+        bootstrap = Bootstrap(join=True, type=ss.type)
+        wb.put(
+            keys.bootstrap_key(cid, node_id), codec.encode_bootstrap(bootstrap)
+        )
         st = State(term=ss.term, commit=ss.index)
         wb.put(keys.state_key(cid, node_id), codec.encode_state(st))
         wb.put(keys.max_index_key(cid, node_id), ss.index.to_bytes(8, "big"))

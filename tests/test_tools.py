@@ -1,0 +1,149 @@
+"""Operator tools tests: import_snapshot quorum repair + checkdisk."""
+import json
+import os
+import time
+
+import pytest
+
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.tools import (
+    ErrIncompleteSnapshot,
+    ErrInvalidMembers,
+    ErrPathNotExist,
+    check_disk,
+    import_snapshot,
+)
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+CLUSTER = 1
+
+
+class KV(IStateMachine):
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=1)
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+def _nh_config(nid, tmp, reg):
+    return NodeHostConfig(
+        deployment_id=11, rtt_millisecond=5,
+        nodehost_dir=f"{tmp}/h{nid}",
+        raft_address=f"t{nid}:1",
+        raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+    )
+
+
+def _wait_leader(hosts, deadline_s=20):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for nid, nh in hosts.items():
+            lid, ok = nh.get_leader_id(CLUSTER)
+            if ok and lid == nid:
+                return nid
+        time.sleep(0.02)
+    raise AssertionError("no leader")
+
+
+def test_check_disk(tmp_path):
+    out = check_disk(str(tmp_path), count=20, payload_size=512)
+    assert out["count"] == 20
+    assert out["fsync_p50_us"] > 0
+    assert out["synced_writes_per_sec"] > 0
+    assert os.listdir(str(tmp_path)) == []  # probe file removed
+
+
+def test_import_snapshot_quorum_repair(tmp_path):
+    """The full repair story: 3-node cluster loses 2 nodes permanently; an
+    exported snapshot is imported on the survivor with a single-member
+    membership; the survivor restarts alone with all data."""
+    reg = _Registry()
+    hosts = {}
+    members = {n: f"t{n}:1" for n in (1, 2, 3)}
+    for nid in (1, 2, 3):
+        nh = NodeHost(_nh_config(nid, str(tmp_path), reg))
+        nh.start_cluster(
+            members, False, lambda c, n: KV(),
+            Config(cluster_id=CLUSTER, node_id=nid,
+                   election_rtt=10, heartbeat_rtt=2),
+        )
+        hosts[nid] = nh
+    leader = _wait_leader(hosts)
+    s = hosts[leader].get_noop_session(CLUSTER)
+    for i in range(10):
+        hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), timeout_s=5.0)
+
+    export_root = str(tmp_path / "export")
+    os.makedirs(export_root)
+    hosts[leader].sync_request_snapshot(
+        CLUSTER, export_path=export_root, timeout_s=10.0
+    )
+    exported = [
+        os.path.join(export_root, d) for d in os.listdir(export_root)
+    ]
+    assert len(exported) == 1, exported
+    src = exported[0]
+    assert os.path.exists(os.path.join(src, "snapshot.metadata"))
+
+    # catastrophe: all hosts stop; 2 and 3 are gone forever
+    for nh in hosts.values():
+        nh.stop()
+
+    # operator repairs node 1 with a single-member cluster
+    cfg1 = _nh_config(1, str(tmp_path), reg)
+    ss = import_snapshot(cfg1, src, {1: "t1:1"}, 1)
+    assert ss.imported and ss.membership.addresses == {1: "t1:1"}
+    assert ss.membership.removed.keys() >= {2, 3}
+
+    # survivor restarts alone and owns all the data
+    nh1 = NodeHost(_nh_config(1, str(tmp_path), reg))
+    nh1.start_cluster(
+        {}, False, lambda c, n: KV(),
+        Config(cluster_id=CLUSTER, node_id=1,
+               election_rtt=10, heartbeat_rtt=2),
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        lid, ok = nh1.get_leader_id(CLUSTER)
+        if ok and lid == 1:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("survivor never became single-node leader")
+    assert nh1.sync_read(CLUSTER, "k9", timeout_s=10.0) == "v9"
+    m = nh1.get_cluster_membership(CLUSTER)
+    assert set(m.addresses) == {1}
+    # and it can still make progress
+    s = nh1.get_noop_session(CLUSTER)
+    nh1.sync_propose(s, b"post=repair", timeout_s=10.0)
+    assert nh1.sync_read(CLUSTER, "post", timeout_s=10.0) == "repair"
+    nh1.stop()
+
+
+def test_import_snapshot_validation(tmp_path):
+    cfg = NodeHostConfig(
+        deployment_id=1, rtt_millisecond=5,
+        nodehost_dir=str(tmp_path / "nh"), raft_address="v1:1",
+    )
+    with pytest.raises(ErrInvalidMembers):
+        import_snapshot(cfg, str(tmp_path), {2: "v2:1"}, 1)  # 1 not a member
+    with pytest.raises(ErrPathNotExist):
+        import_snapshot(cfg, str(tmp_path / "nope"), {1: "v1:1"}, 1)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ErrIncompleteSnapshot):
+        import_snapshot(cfg, str(empty), {1: "v1:1"}, 1)
