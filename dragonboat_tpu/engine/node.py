@@ -460,8 +460,13 @@ class Node:
                 return
             ss, env = self.sm.save_snapshot(req)
             self.snapshotter.commit(ss, req)
-            self.log_reader.create_snapshot(ss)
-            self._compact_log(ss, req)
+            if not req.is_exported():
+                # exported snapshots leave the node's own history alone:
+                # no logdb record was written, so advancing the log
+                # reader / compacting here would delete entries the node
+                # still needs to replay (cf. nodehost.go exported path)
+                self.log_reader.create_snapshot(ss)
+                self._compact_log(ss, req)
             self.pending_snapshot.apply(ss.index, ignored=False)
         except Exception:
             self.pending_snapshot.apply(0, ignored=False, failed=True)

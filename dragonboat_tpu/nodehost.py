@@ -48,6 +48,10 @@ from .types import (
 )
 
 
+class ErrDirNotExist(RequestError):
+    """Export path does not exist (cf. nodehost.go:905)."""
+
+
 class ErrClusterAlreadyExist(RequestError):
     code = "cluster already exist"
 
@@ -425,6 +429,10 @@ class NodeHost(IMessageHandler):
         timeout_s: float = 10.0,
     ) -> RequestState:
         """cf. nodehost.go:877-949 RequestSnapshot (incl. exported)."""
+        if export_path and not os.path.isdir(export_path):
+            # fail fast before any snapshot work (cf. nodehost.go:905
+            # ErrDirNotExist)
+            raise ErrDirNotExist(export_path)
         node = self._get_node(cluster_id)
         req = SSRequest(
             type=SS_REQ_EXPORTED if export_path else SS_REQ_USER,

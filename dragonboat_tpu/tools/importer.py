@@ -44,7 +44,13 @@ def _read_metadata(src_dir: str) -> Snapshot:
 
 def _check_members(old: Membership, members: Dict[int, str]) -> None:
     """cf. import.go:313-333 checkMembers."""
+    by_addr: Dict[str, int] = {}
     for nid, addr in members.items():
+        if addr in by_addr:
+            raise ErrInvalidMembers(
+                f"nodes {by_addr[addr]} and {nid} share address {addr}"
+            )
+        by_addr[addr] = nid
         if nid in old.addresses and old.addresses[nid] != addr:
             raise ErrInvalidMembers(f"node {nid} address changed")
         if nid in old.observers:
@@ -53,6 +59,12 @@ def _check_members(old: Membership, members: Dict[int, str]) -> None:
             raise ErrInvalidMembers(f"adding observer {nid} as regular node")
         if nid in old.removed:
             raise ErrInvalidMembers(f"adding removed node {nid}")
+        # a new node must not take over an existing node's address
+        for onid, oaddr in old.addresses.items():
+            if nid != onid and addr == oaddr:
+                raise ErrInvalidMembers(
+                    f"node {nid} reuses node {onid}'s address {addr}"
+                )
 
 
 def _processed_record(
@@ -125,14 +137,22 @@ def import_snapshot(
     os.makedirs(nh_dir, exist_ok=True)
     part = f"snapshot-part-{old.cluster_id:020d}-{node_id:020d}"
     node_ss_dir = os.path.join(nh_dir, "snapshots", part)
-    if os.path.exists(node_ss_dir):
-        shutil.rmtree(node_ss_dir)  # rewrite history: old images are dead
     final = os.path.join(node_ss_dir, f"snapshot-{old.index:016X}")
-    os.makedirs(final)
+    # crash-safe ordering: (1) materialize the new image via tmp+rename,
+    # (2) rewrite the logdb records in one atomic batch, (3) only then
+    # delete the obsolete images. A crash at any point leaves either the
+    # old state fully intact or the new state fully usable.
+    tmp = final + ".importing"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
     for name in os.listdir(src_dir):
         if name == SNAPSHOT_METADATA_FILENAME:
             continue
-        shutil.copy2(os.path.join(src_dir, name), os.path.join(final, name))
+        shutil.copy2(os.path.join(src_dir, name), os.path.join(tmp, name))
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)
 
     ss = _processed_record(final, old, member_nodes)
     if nh_config.logdb_factory is not None:
@@ -143,6 +163,10 @@ def import_snapshot(
         logdb.import_snapshot(ss, node_id)
     finally:
         logdb.close()
+    for name in os.listdir(node_ss_dir):
+        p = os.path.join(node_ss_dir, name)
+        if p != final and os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
     return ss
 
 

@@ -1,0 +1,408 @@
+// Implementation of the embedding C ABI (dragonboat_tpu.h) over libpython.
+//
+// Counterpart of the reference's binding/binding.go (cgo exports over the
+// Go runtime). A thin Python glue module (_GLUE below) is loaded into the
+// embedded interpreter once; every C call then acquires the GIL, invokes
+// one glue function, and converts results. The GIL is released between
+// calls so the framework's own Python threads (step workers, transport,
+// tick loop) run freely.
+
+#include "dragonboat_tpu.h"
+
+// required for '#' length formats to take Py_ssize_t (fatal abort
+// otherwise on Python >= 3.10)
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+const char* _GLUE = R"PY(
+import json as _json
+
+from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.cpp_sm import CppStateMachineFactory
+
+_hosts = {}
+_factories = {}
+_next_handle = 1
+
+
+def new_nodehost(cfg_json):
+    global _next_handle
+    cfg = NodeHostConfig(**_json.loads(cfg_json))
+    nh = NodeHost(cfg)
+    h = _next_handle
+    _next_handle += 1
+    _hosts[h] = nh
+    return h
+
+
+def stop_nodehost(h):
+    _hosts.pop(h).stop()
+
+
+def start_cluster(h, members_json, join, plugin_path, cc_json):
+    members = {int(k): v for k, v in _json.loads(members_json).items()}
+    factory = _factories.get(plugin_path)
+    if factory is None:
+        factory = CppStateMachineFactory(plugin_path)
+        _factories[plugin_path] = factory
+    _hosts[h].start_cluster(
+        members, bool(join), factory, Config(**_json.loads(cc_json))
+    )
+
+
+def stop_cluster(h, cluster_id):
+    _hosts[h].stop_cluster(cluster_id)
+
+
+def sync_propose(h, cluster_id, cmd, timeout_s):
+    nh = _hosts[h]
+    session = nh.get_noop_session(cluster_id)
+    return nh.sync_propose(session, cmd, timeout_s).value
+
+
+def sync_read(h, cluster_id, query, timeout_s):
+    v = _hosts[h].sync_read(cluster_id, query, timeout_s)
+    if v is None:
+        return None
+    return v if isinstance(v, bytes) else str(v).encode()
+
+
+def get_leader_id(h, cluster_id):
+    return _hosts[h].get_leader_id(cluster_id)
+
+
+def leader_transfer(h, cluster_id, target):
+    _hosts[h].request_leader_transfer(cluster_id, target)
+
+
+def add_node(h, cluster_id, node_id, address, timeout_s):
+    _hosts[h].sync_request_add_node(
+        cluster_id, node_id, address, timeout_s=timeout_s
+    )
+
+
+def delete_node(h, cluster_id, node_id, timeout_s):
+    _hosts[h].sync_request_delete_node(
+        cluster_id, node_id, timeout_s=timeout_s
+    )
+)PY";
+
+std::mutex g_init_mu;
+bool g_initialized = false;
+PyObject* g_glue = nullptr;  // module dict holding the glue functions
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) std::snprintf(err, (size_t)errlen, "%s", msg.c_str());
+}
+
+// Fetch the current Python exception as a string and clear it.
+std::string fetch_exc() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string out = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) {
+        out = c;
+        if (type) {
+          PyObject* tn = PyObject_GetAttrString(type, "__name__");
+          if (tn) {
+            const char* tc = PyUnicode_AsUTF8(tn);
+            if (tc) out = std::string(tc) + ": " + out;
+            Py_DECREF(tn);
+          }
+        }
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return out;
+}
+
+// RAII GIL holder for calls from arbitrary C threads.
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// Call glue function `name` with args tuple; returns new ref or null
+// (error message in *errmsg).
+PyObject* call_glue(const char* name, PyObject* args, std::string* errmsg) {
+  PyObject* fn = PyDict_GetItemString(g_glue, name);  // borrowed
+  if (!fn) {
+    *errmsg = std::string("glue function missing: ") + name;
+    return nullptr;
+  }
+  PyObject* ret = PyObject_CallObject(fn, args);
+  if (!ret) *errmsg = fetch_exc();
+  return ret;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dbtpu_init(void) {
+  std::lock_guard<std::mutex> g(g_init_mu);
+  if (g_initialized) return 0;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* mod = PyImport_AddModule("_dbtpu_embed");  // borrowed
+  if (!mod) {
+    std::fprintf(stderr, "dbtpu_init: %s\n", fetch_exc().c_str());
+    PyGILState_Release(st);
+    return -1;
+  }
+  PyObject* dict = PyModule_GetDict(mod);  // borrowed
+  // PyRun_String auto-inserts __builtins__ into bare globals
+  PyObject* res =
+      PyRun_String(_GLUE, Py_file_input, dict, dict);
+  int rc = 0;
+  if (!res) {
+    std::fprintf(stderr, "dbtpu_init: %s\n", fetch_exc().c_str());
+    rc = -1;
+  } else {
+    Py_DECREF(res);
+    g_glue = dict;
+    Py_INCREF(g_glue);
+    g_initialized = true;
+  }
+  PyGILState_Release(st);
+  if (rc == 0 && we_initialized) {
+    // We own the interpreter: Py_InitializeEx left this thread holding
+    // the GIL, release it so framework threads run between C calls. When
+    // the host app already embeds Python, its GIL discipline is left
+    // untouched (PyGILState_Release above restored the prior state).
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+void dbtpu_finalize(void) {
+  std::lock_guard<std::mutex> g(g_init_mu);
+  if (!g_initialized) return;
+  // NOTE: the framework owns daemon threads; a full Py_Finalize from an
+  // embedder is unsafe while NodeHosts run. Stop hosts first.
+  g_initialized = false;
+}
+
+dbtpu_nodehost dbtpu_nodehost_new(const char* config_json, char* err,
+                                  int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue("(s)", config_json);
+  PyObject* ret = call_glue("new_nodehost", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return 0;
+  }
+  uint64_t h = PyLong_AsUnsignedLongLong(ret);
+  Py_DECREF(ret);
+  return h;
+}
+
+int dbtpu_nodehost_stop(dbtpu_nodehost nh, char* err, int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue("(K)", (unsigned long long)nh);
+  PyObject* ret = call_glue("stop_nodehost", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_start_cluster(dbtpu_nodehost nh, const char* members_json,
+                        int join, const char* plugin_path,
+                        const char* cluster_config_json, char* err,
+                        int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue("(Ksiss)", (unsigned long long)nh,
+                                 members_json, join, plugin_path,
+                                 cluster_config_json);
+  PyObject* ret = call_glue("start_cluster", args, &msg);
+  Py_XDECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_stop_cluster(dbtpu_nodehost nh, uint64_t cluster_id, char* err,
+                       int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args =
+      Py_BuildValue("(KK)", (unsigned long long)nh,
+                    (unsigned long long)cluster_id);
+  PyObject* ret = call_glue("stop_cluster", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_sync_propose(dbtpu_nodehost nh, uint64_t cluster_id,
+                       const uint8_t* cmd, size_t cmdlen, double timeout_s,
+                       uint64_t* result, char* err, int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue(
+      "(KKy#d)", (unsigned long long)nh, (unsigned long long)cluster_id,
+      (const char*)cmd, (Py_ssize_t)cmdlen, timeout_s);
+  PyObject* ret = call_glue("sync_propose", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  if (result) *result = PyLong_AsUnsignedLongLong(ret);
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_sync_read(dbtpu_nodehost nh, uint64_t cluster_id,
+                    const uint8_t* query, size_t querylen, double timeout_s,
+                    uint8_t** out, size_t* outlen, char* err, int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue(
+      "(KKy#d)", (unsigned long long)nh, (unsigned long long)cluster_id,
+      (const char*)query, (Py_ssize_t)querylen, timeout_s);
+  PyObject* ret = call_glue("sync_read", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  *out = nullptr;
+  *outlen = 0;
+  if (ret != Py_None) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(ret, &buf, &n) == 0) {
+      *out = (uint8_t*)::malloc(n ? (size_t)n : 1);
+      std::memcpy(*out, buf, (size_t)n);
+      *outlen = (size_t)n;
+    }
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_get_leader_id(dbtpu_nodehost nh, uint64_t cluster_id,
+                        uint64_t* leader_id, int* has_leader, char* err,
+                        int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue("(KK)", (unsigned long long)nh,
+                                 (unsigned long long)cluster_id);
+  PyObject* ret = call_glue("get_leader_id", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  unsigned long long lid = 0;
+  int ok = 0;
+  if (!PyArg_ParseTuple(ret, "Kp", &lid, &ok)) {
+    Py_DECREF(ret);
+    set_err(err, errlen, fetch_exc());
+    return -1;
+  }
+  Py_DECREF(ret);
+  if (leader_id) *leader_id = lid;
+  if (has_leader) *has_leader = ok;
+  return 0;
+}
+
+int dbtpu_request_leader_transfer(dbtpu_nodehost nh, uint64_t cluster_id,
+                                  uint64_t target_node_id, char* err,
+                                  int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args =
+      Py_BuildValue("(KKK)", (unsigned long long)nh,
+                    (unsigned long long)cluster_id,
+                    (unsigned long long)target_node_id);
+  PyObject* ret = call_glue("leader_transfer", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_sync_add_node(dbtpu_nodehost nh, uint64_t cluster_id,
+                        uint64_t node_id, const char* address,
+                        double timeout_s, char* err, int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue(
+      "(KKKsd)", (unsigned long long)nh, (unsigned long long)cluster_id,
+      (unsigned long long)node_id, address, timeout_s);
+  PyObject* ret = call_glue("add_node", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+int dbtpu_sync_delete_node(dbtpu_nodehost nh, uint64_t cluster_id,
+                           uint64_t node_id, double timeout_s, char* err,
+                           int errlen) {
+  Gil gil;
+  std::string msg;
+  PyObject* args = Py_BuildValue(
+      "(KKKd)", (unsigned long long)nh, (unsigned long long)cluster_id,
+      (unsigned long long)node_id, timeout_s);
+  PyObject* ret = call_glue("delete_node", args, &msg);
+  Py_DECREF(args);
+  if (!ret) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+void dbtpu_free(void* p) { ::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+/* C ABI for embedding the dragonboat-tpu framework in C/C++ applications.
+ *
+ * TPU-era equivalent of the reference's C binding
+ * (binding/include/dragonboat/binding.h, binding/binding.go: cgo exports
+ * over the Go runtime): here the runtime is the Python host framework,
+ * embedded via libpython behind this flat C API. State machines are C++
+ * plugins built against native/sm_sdk/dragonboat_tpu/statemachine.h —
+ * a C/C++ application never touches Python.
+ *
+ * Threading: dbtpu_init() starts the runtime (call once, any thread);
+ * every other call is safe from any thread. Errors are returned as
+ * negative codes with a message copied into the caller's err buffer.
+ *
+ * Configs cross the ABI as JSON strings matching the Python dataclass
+ * field names (config.py NodeHostConfig / Config), e.g.
+ *   nodehost: {"deployment_id":1,"rtt_millisecond":5,
+ *              "nodehost_dir":"/tmp/nh1","raft_address":"127.0.0.1:26000"}
+ *   cluster:  {"cluster_id":1,"node_id":1,"election_rtt":10,
+ *              "heartbeat_rtt":2}
+ */
+#ifndef DBTPU_BINDING_H_
+#define DBTPU_BINDING_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint64_t dbtpu_nodehost;  /* opaque handle; 0 is invalid */
+
+/* Start / stop the embedded runtime. init is idempotent; returns 0 on
+ * success. */
+int dbtpu_init(void);
+void dbtpu_finalize(void);
+
+/* NodeHost lifecycle. Returns 0 handle on failure (message in err). */
+dbtpu_nodehost dbtpu_nodehost_new(const char* config_json, char* err,
+                                  int errlen);
+int dbtpu_nodehost_stop(dbtpu_nodehost nh, char* err, int errlen);
+
+/* Start a Raft group whose state machine is the C++ plugin at
+ * plugin_path (built with DBTPU_REGISTER_STATEMACHINE).
+ * members_json: {"1":"addr1","2":"addr2"} ({} on restart/join). */
+int dbtpu_start_cluster(dbtpu_nodehost nh, const char* members_json,
+                        int join, const char* plugin_path,
+                        const char* cluster_config_json, char* err,
+                        int errlen);
+int dbtpu_stop_cluster(dbtpu_nodehost nh, uint64_t cluster_id, char* err,
+                       int errlen);
+
+/* Make a linearizable proposal (no-op client session); on success *result
+ * receives the SM Update return value. */
+int dbtpu_sync_propose(dbtpu_nodehost nh, uint64_t cluster_id,
+                       const uint8_t* cmd, size_t cmdlen, double timeout_s,
+                       uint64_t* result, char* err, int errlen);
+
+/* Linearizable read (ReadIndex). *out receives a malloc'd buffer the
+ * caller frees with dbtpu_free; *outlen its size. A missing value yields
+ * rc 0 with *out NULL. */
+int dbtpu_sync_read(dbtpu_nodehost nh, uint64_t cluster_id,
+                    const uint8_t* query, size_t querylen, double timeout_s,
+                    uint8_t** out, size_t* outlen, char* err, int errlen);
+
+/* *leader_id / *has_leader via out-params; returns 0 on success. */
+int dbtpu_get_leader_id(dbtpu_nodehost nh, uint64_t cluster_id,
+                        uint64_t* leader_id, int* has_leader, char* err,
+                        int errlen);
+
+int dbtpu_request_leader_transfer(dbtpu_nodehost nh, uint64_t cluster_id,
+                                  uint64_t target_node_id, char* err,
+                                  int errlen);
+
+/* Membership changes (synchronous). */
+int dbtpu_sync_add_node(dbtpu_nodehost nh, uint64_t cluster_id,
+                        uint64_t node_id, const char* address,
+                        double timeout_s, char* err, int errlen);
+int dbtpu_sync_delete_node(dbtpu_nodehost nh, uint64_t cluster_id,
+                           uint64_t node_id, double timeout_s, char* err,
+                           int errlen);
+
+void dbtpu_free(void* p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DBTPU_BINDING_H_ */

@@ -1,0 +1,90 @@
+// Example C++ state machine plugin: an ordered KV store.
+//
+// Counterpart of the reference's C++ test SMs (internal/tests/cppkv,
+// binding/cpp examples). Commands are "key=value" bytes; lookups are the
+// key; snapshots serialize the map with length-prefixed records. Built by
+// native/Makefile into build/libkvstore_sm.so and loaded in tests through
+// dragonboat_tpu.cpp_sm.CppStateMachineFactory.
+
+#include <cstdint>
+#include <map>
+#include <string>
+
+#include "../sm_sdk/dragonboat_tpu/statemachine.h"
+
+namespace {
+
+class KVStore : public dbtpu::RegularStateMachine {
+ public:
+  KVStore(uint64_t cluster_id, uint64_t node_id)
+      : dbtpu::RegularStateMachine(cluster_id, node_id) {}
+
+  uint64_t Update(const uint8_t* data, size_t len) override {
+    std::string cmd(reinterpret_cast<const char*>(data), len);
+    size_t eq = cmd.find('=');
+    if (eq == std::string::npos) return 0;
+    table_[cmd.substr(0, eq)] = cmd.substr(eq + 1);
+    return table_.size();
+  }
+
+  bool Lookup(const uint8_t* query, size_t len,
+              std::string* result) override {
+    auto it = table_.find(
+        std::string(reinterpret_cast<const char*>(query), len));
+    if (it == table_.end()) return false;
+    *result = it->second;
+    return true;
+  }
+
+  uint64_t GetHash() override {
+    // FNV-1a over sorted k=v pairs (std::map is ordered)
+    uint64_t h = 1469598103934665603ull;
+    for (const auto& kv : table_) {
+      for (char c : kv.first) h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
+      h = (h ^ '=') * 1099511628211ull;
+      for (char c : kv.second) h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
+    }
+    return h;
+  }
+
+  bool SaveSnapshot(dbtpu::SnapshotWriter* w) override {
+    for (const auto& kv : table_) {
+      uint32_t kl = static_cast<uint32_t>(kv.first.size());
+      uint32_t vl = static_cast<uint32_t>(kv.second.size());
+      if (!w->Write(&kl, 4) || !w->Write(kv.first.data(), kl) ||
+          !w->Write(&vl, 4) || !w->Write(kv.second.data(), vl)) {
+        return false;
+      }
+    }
+    return true;
+  }
+
+  bool RecoverFromSnapshot(dbtpu::SnapshotReader* r) override {
+    table_.clear();
+    std::string blob;
+    if (!r->ReadAll(&blob)) return false;
+    size_t off = 0;
+    while (off + 4 <= blob.size()) {
+      uint32_t kl;
+      std::memcpy(&kl, blob.data() + off, 4);
+      off += 4;
+      if (off + kl + 4 > blob.size()) return false;
+      std::string k = blob.substr(off, kl);
+      off += kl;
+      uint32_t vl;
+      std::memcpy(&vl, blob.data() + off, 4);
+      off += 4;
+      if (off + vl > blob.size()) return false;
+      table_[k] = blob.substr(off, vl);
+      off += vl;
+    }
+    return true;
+  }
+
+ private:
+  std::map<std::string, std::string> table_;
+};
+
+}  // namespace
+
+DBTPU_REGISTER_STATEMACHINE(KVStore)

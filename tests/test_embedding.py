@@ -1,0 +1,47 @@
+"""Embedding C ABI test: builds native/binding (libdbtpu.so + embed_demo)
+and runs the pure-C++ demo app — NodeHost lifecycle, cluster start with a
+C++ SM plugin, propose, linearizable read, missing-key read, stop — all
+through the flat C API with no Python in the app
+(cf. reference binding/binding.go + binding/cpp tests)."""
+import os
+import subprocess
+
+import pytest
+
+_NATIVE = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "native"))
+_DEMO = os.path.join(_NATIVE, "build", "embed_demo")
+_PLUGIN = os.path.join(_NATIVE, "build", "libkvstore_sm.so")
+
+
+def _built() -> bool:
+    import shutil
+
+    if shutil.which("g++") is None:
+        return False  # genuinely no toolchain: skip
+    # toolchain present: a build FAILURE must fail loudly, not skip
+    proc = subprocess.run(
+        ["make", "-C", _NATIVE, "all", "embed"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    return os.path.exists(_DEMO) and os.path.exists(_PLUGIN)
+
+
+pytestmark = pytest.mark.skipif(
+    not _built(), reason="native toolchain unavailable"
+)
+
+
+@pytest.mark.slow
+def test_embed_demo_runs(tmp_path):
+    env = dict(os.environ)
+    repo = os.path.abspath(os.path.join(_NATIVE, ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [_DEMO, str(tmp_path), _PLUGIN],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "EMBED DEMO PASS" in proc.stdout

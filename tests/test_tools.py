@@ -147,3 +147,60 @@ def test_import_snapshot_validation(tmp_path):
     empty.mkdir()
     with pytest.raises(ErrIncompleteSnapshot):
         import_snapshot(cfg, str(empty), {1: "v1:1"}, 1)
+
+
+def test_export_does_not_compact_own_history(tmp_path):
+    """Regression: an exported snapshot must leave the node's own log and
+    snapshot records alone — with compaction_overhead set, a restart after
+    export must still replay (the export writes no logdb record, so
+    compacting against it would strand the node)."""
+    reg = _Registry()
+    nh = NodeHost(_nh_config(1, str(tmp_path), reg))
+    nh.start_cluster(
+        {1: "t1:1"}, False, lambda c, n: KV(),
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+               heartbeat_rtt=2, compaction_overhead=3),
+    )
+    _wait_leader({1: nh})
+    s = nh.get_noop_session(CLUSTER)
+    for i in range(20):
+        nh.sync_propose(s, f"e{i}=x{i}".encode(), timeout_s=5.0)
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    nh.sync_request_snapshot(CLUSTER, export_path=str(exp), timeout_s=10.0)
+    nh.stop()
+
+    nh2 = NodeHost(_nh_config(1, str(tmp_path), reg))
+    nh2.start_cluster(
+        {}, False, lambda c, n: KV(),
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+               heartbeat_rtt=2, compaction_overhead=3),
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            if nh2.stale_read(CLUSTER, "e19") == "x19":
+                break
+        except Exception:
+            pass
+        time.sleep(0.02)
+    else:
+        raise AssertionError("node failed to recover after export")
+    nh2.stop()
+
+
+def test_request_snapshot_bad_export_path(tmp_path):
+    from dragonboat_tpu.nodehost import ErrDirNotExist
+
+    reg = _Registry()
+    nh = NodeHost(_nh_config(1, str(tmp_path), reg))
+    nh.start_cluster(
+        {1: "t1:1"}, False, lambda c, n: KV(),
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+               heartbeat_rtt=2),
+    )
+    try:
+        with pytest.raises(ErrDirNotExist):
+            nh.request_snapshot(CLUSTER, export_path=str(tmp_path / "missing"))
+    finally:
+        nh.stop()
