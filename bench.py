@@ -25,7 +25,7 @@ from dragonboat_tpu.ops.state import (
     MSG,
     KernelConfig,
     RaftTensors,
-    configure_group,
+    configure_groups_uniform,
     init_state,
     make_empty_inbox,
 )
@@ -48,11 +48,16 @@ def main() -> None:
     ap.add_argument("--groups", type=int, default=50_000)
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--inbox-depth", type=int, default=8)
+    ap.add_argument("--entries", type=int, default=8)
+    ap.add_argument("--log-window", type=int, default=512)
+    ap.add_argument("--peers", type=int, default=8)
     args = ap.parse_args()
 
     cfg = KernelConfig(
-        groups=args.groups, peers=8, log_window=512, inbox_depth=8,
-        max_entries_per_msg=8, readindex_depth=4,
+        groups=args.groups, peers=args.peers, log_window=args.log_window,
+        inbox_depth=args.inbox_depth, max_entries_per_msg=args.entries,
+        readindex_depth=4,
     )
     G, K, E = cfg.groups, cfg.inbox_depth, cfg.max_entries_per_msg
 
@@ -60,8 +65,7 @@ def main() -> None:
     # one voting replica per group: commit is immediate, the bench measures
     # pure kernel throughput (the multi-replica path adds transport rounds,
     # not kernel work — every lane runs the full handler table regardless)
-    for g in range(G):
-        state = configure_group(state, g, self_slot=0, voting_slots=(0,))
+    state = configure_groups_uniform(state, self_slot=0, voting_slots=(0,))
 
     fn = jax.jit(functools.partial(bench_step, cfg=cfg), donate_argnums=(0,))
 

@@ -334,6 +334,49 @@ def configure_group(
     return state._replace(**upd)
 
 
+def configure_groups_uniform(
+    state: RaftTensors,
+    self_slot: int,
+    voting_slots,
+    election_timeout: int = 10,
+    heartbeat_timeout: int = 1,
+    check_quorum: bool = False,
+) -> RaftTensors:
+    """Vectorized configure for ALL lanes with identical membership shape —
+    one whole-array update instead of G scalar dispatches. This is the bulk
+    path benchmarks and fleet bring-up use (configure_group remains the
+    per-lane reconcile for StartCluster / config change)."""
+    G, P = state.member.shape
+    member = np.zeros((P,), bool)
+    voting = np.zeros((P,), bool)
+    for s in voting_slots:
+        member[s] = True
+        voting[s] = True
+    seeds = np.asarray(state.seed).astype(np.uint64)
+    # same mix as _mix() below, vectorized with uint64 headroom
+    M = np.uint64(0xFFFFFFFF)
+    x = ((seeds * np.uint64(2654435761)) ^ np.uint64(self_slot * 2246822519)) & M
+    x ^= x >> np.uint64(15)
+    x = (x * np.uint64(2246822519)) & M
+    x ^= x >> np.uint64(13)
+    rand_to = (election_timeout + (x % np.uint64(election_timeout))).astype(
+        np.int32
+    )
+    return state._replace(
+        active=jnp.ones((G,), bool),
+        self_slot=jnp.full((G,), self_slot, jnp.int32),
+        member=jnp.broadcast_to(jnp.asarray(member), (G, P)),
+        voting=jnp.broadcast_to(jnp.asarray(voting), (G, P)),
+        observer=jnp.zeros((G, P), bool),
+        witness=jnp.zeros((G, P), bool),
+        role=jnp.full((G,), ROLE.FOLLOWER, jnp.int32),
+        election_timeout=jnp.full((G,), election_timeout, jnp.int32),
+        heartbeat_timeout=jnp.full((G,), heartbeat_timeout, jnp.int32),
+        rand_timeout=jnp.asarray(rand_to),
+        check_quorum=jnp.full((G,), check_quorum, bool),
+    )
+
+
 def _mix(a, b, c):
     """Cheap deterministic integer mix (xorshift-multiply), used for
     randomized election timeouts; must match kernel._mix (uint32 wraparound

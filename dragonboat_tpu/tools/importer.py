@@ -150,9 +150,16 @@ def import_snapshot(
         if name == SNAPSHOT_METADATA_FILENAME:
             continue
         shutil.copy2(os.path.join(src_dir, name), os.path.join(tmp, name))
+    # keep a same-index existing image alive until the new one is in place
+    # (a crash between delete and rename must never destroy the only copy a
+    # live logdb record points at)
+    replaced = final + ".replaced"
+    if os.path.exists(replaced):
+        shutil.rmtree(replaced)
     if os.path.exists(final):
-        shutil.rmtree(final)
+        os.rename(final, replaced)
     os.rename(tmp, final)
+    shutil.rmtree(replaced, ignore_errors=True)
 
     ss = _processed_record(final, old, member_nodes)
     if nh_config.logdb_factory is not None:

@@ -146,6 +146,12 @@ class Gil {
 // Call glue function `name` with args tuple; returns new ref or null
 // (error message in *errmsg).
 PyObject* call_glue(const char* name, PyObject* args, std::string* errmsg) {
+  if (!args) {
+    // Py_BuildValue failed (bad UTF-8 in a string arg, OOM): report
+    // instead of calling with a NULL tuple
+    *errmsg = PyErr_Occurred() ? fetch_exc() : "argument marshalling failed";
+    return nullptr;
+  }
   PyObject* fn = PyDict_GetItemString(g_glue, name);  // borrowed
   if (!fn) {
     *errmsg = std::string("glue function missing: ") + name;
@@ -214,7 +220,7 @@ dbtpu_nodehost dbtpu_nodehost_new(const char* config_json, char* err,
   std::string msg;
   PyObject* args = Py_BuildValue("(s)", config_json);
   PyObject* ret = call_glue("new_nodehost", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return 0;
@@ -229,7 +235,7 @@ int dbtpu_nodehost_stop(dbtpu_nodehost nh, char* err, int errlen) {
   std::string msg;
   PyObject* args = Py_BuildValue("(K)", (unsigned long long)nh);
   PyObject* ret = call_glue("stop_nodehost", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -265,7 +271,7 @@ int dbtpu_stop_cluster(dbtpu_nodehost nh, uint64_t cluster_id, char* err,
       Py_BuildValue("(KK)", (unsigned long long)nh,
                     (unsigned long long)cluster_id);
   PyObject* ret = call_glue("stop_cluster", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -283,7 +289,7 @@ int dbtpu_sync_propose(dbtpu_nodehost nh, uint64_t cluster_id,
       "(KKy#d)", (unsigned long long)nh, (unsigned long long)cluster_id,
       (const char*)cmd, (Py_ssize_t)cmdlen, timeout_s);
   PyObject* ret = call_glue("sync_propose", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -302,7 +308,7 @@ int dbtpu_sync_read(dbtpu_nodehost nh, uint64_t cluster_id,
       "(KKy#d)", (unsigned long long)nh, (unsigned long long)cluster_id,
       (const char*)query, (Py_ssize_t)querylen, timeout_s);
   PyObject* ret = call_glue("sync_read", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -330,7 +336,7 @@ int dbtpu_get_leader_id(dbtpu_nodehost nh, uint64_t cluster_id,
   PyObject* args = Py_BuildValue("(KK)", (unsigned long long)nh,
                                  (unsigned long long)cluster_id);
   PyObject* ret = call_glue("get_leader_id", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -358,7 +364,7 @@ int dbtpu_request_leader_transfer(dbtpu_nodehost nh, uint64_t cluster_id,
                     (unsigned long long)cluster_id,
                     (unsigned long long)target_node_id);
   PyObject* ret = call_glue("leader_transfer", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -376,7 +382,7 @@ int dbtpu_sync_add_node(dbtpu_nodehost nh, uint64_t cluster_id,
       "(KKKsd)", (unsigned long long)nh, (unsigned long long)cluster_id,
       (unsigned long long)node_id, address, timeout_s);
   PyObject* ret = call_glue("add_node", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;
@@ -394,7 +400,7 @@ int dbtpu_sync_delete_node(dbtpu_nodehost nh, uint64_t cluster_id,
       "(KKKd)", (unsigned long long)nh, (unsigned long long)cluster_id,
       (unsigned long long)node_id, timeout_s);
   PyObject* ret = call_glue("delete_node", args, &msg);
-  Py_DECREF(args);
+  Py_XDECREF(args);
   if (!ret) {
     set_err(err, errlen, msg);
     return -1;

