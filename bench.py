@@ -15,7 +15,77 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
+
+
+from dragonboat_tpu._jaxenv import pin_cpu
+
+
+def _ensure_live_backend() -> str:
+    """Probe JAX backend init in a subprocess before touching it in-process.
+
+    The environment's 'axon' TPU-tunnel backend can hang or fail during
+    client creation; an in-process hang would wedge jax's backend lock for
+    good. Probe externally (backend init succeeds in seconds or hangs, so
+    a short timeout suffices; retry once), and fall back to a guarded CPU
+    backend if the accelerator is unreachable. Returns the platform name."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        pin_cpu()
+        return "cpu"
+    probe = (
+        "import jax, sys; d = jax.devices(); "
+        "sys.stdout.write(d[0].platform)"
+    )
+    for _ in range(2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=60,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                platform = r.stdout.strip()
+                if platform == "cpu":
+                    # the probe fell back to cpu (axon failed fast there);
+                    # drop the factory here too or our own init can wedge
+                    pin_cpu()
+                return platform
+        except subprocess.TimeoutExpired:
+            pass
+    pin_cpu()
+    return "cpu-fallback"
+
+
+def _arm_watchdog(seconds: float, platform: str):
+    """The probe can pass and the tunnel still wedge moments later at real
+    backend init. Guarantee the driver one parseable JSON line either way:
+    if the bench has not finished within the deadline, emit an error record
+    and hard-exit. Returns the timer (cancel on success)."""
+    import threading
+
+    def fire() -> None:  # pragma: no cover - only on wedged backends
+        print(
+            json.dumps(
+                {
+                    "metric": "kernel_proposals_per_sec",
+                    "value": 0.0,
+                    "unit": "proposals/s",
+                    "vs_baseline": 0.0,
+                    "platform": platform,
+                    "error": f"watchdog: no result within {seconds:.0f}s",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
 
 import jax
 import jax.numpy as jnp
@@ -52,7 +122,21 @@ def main() -> None:
     ap.add_argument("--entries", type=int, default=8)
     ap.add_argument("--log-window", type=int, default=512)
     ap.add_argument("--peers", type=int, default=8)
+    ap.add_argument("--watchdog-s", type=float, default=480.0)
     args = ap.parse_args()
+
+    platform = _ensure_live_backend()
+    if platform == "cpu-fallback":
+        # accelerator was unreachable: run a reduced CPU workload so the
+        # driver still records a parseable number instead of a timeout
+        args.groups = min(args.groups, 2048)
+        args.steps = min(args.steps, 10)
+        args.log_window = min(args.log_window, 64)
+
+    # only the accelerator path can wedge post-probe (pinned cpu has no
+    # axon factory left); don't kill legitimately slow CPU runs
+    watchdog = _arm_watchdog(args.watchdog_s, platform) if platform not in (
+        "cpu", "cpu-fallback") else None
 
     cfg = KernelConfig(
         groups=args.groups, peers=args.peers, log_window=args.log_window,
@@ -93,6 +177,8 @@ def main() -> None:
         state, commit = fn(state, inbox, ticks)
     jax.block_until_ready(commit)
     dt = time.perf_counter() - t0
+    if watchdog is not None:
+        watchdog.cancel()
 
     # every proposal committed: verify, then report
     expected = (args.warmup + args.steps) * K * E + 1  # +1 leader noop
@@ -108,6 +194,7 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "proposals/s",
                 "vs_baseline": round(value / BASELINE_PROPOSALS_PER_SEC, 3),
+                "platform": platform,
             }
         )
     )

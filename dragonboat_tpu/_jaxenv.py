@@ -1,0 +1,51 @@
+"""JAX backend environment guards shared by tests, bench, and driver hooks.
+
+The environment auto-imports jax via a sitecustomize hook and registers an
+'axon' TPU-tunnel backend whose client creation can hang when the tunnel is
+busy. The plugin monkeypatches xla_bridge._get_backend_uncached, so setting
+JAX_PLATFORMS=cpu alone does NOT prevent the tunnel client from being
+initialized — the factory must be dropped before any backend init.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def pin_cpu(n_devices: int | None = None) -> None:
+    """Pin jax to the cpu platform and drop the axon backend factory.
+
+    Must run before any jax backend is initialized. When ``n_devices`` is
+    given, (re)sets the host-platform virtual device count so a stale value
+    from the environment cannot undersize the mesh.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if _COUNT_FLAG in flags:
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}", flags)
+        else:
+            flags = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = _xb.backends_are_initialized()
+    except Exception:  # pragma: no cover - internal layout changed
+        return
+    if initialized:
+        raise RuntimeError(
+            "pin_cpu() called after a JAX backend was initialized; the cpu "
+            "pin and device-count flags cannot take effect. Call it before "
+            "any jax.devices()/jit dispatch in the process."
+        )
+    try:
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - internal layout changed
+        pass

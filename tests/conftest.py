@@ -1,26 +1,17 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so that
 multi-chip sharding paths are exercised without TPU hardware.
 
-The environment auto-imports jax via a sitecustomize hook and registers an
-'axon' TPU-tunnel backend whose client creation can hang when the tunnel is
-busy. Tests must be hermetic and CPU-only, so before any backend is
-initialized we (a) request the cpu platform, (b) drop the axon backend
-factory, and (c) size the host platform to 8 virtual devices."""
+The backend guard itself (cpu pin + axon-factory drop + host device count)
+lives in dragonboat_tpu._jaxenv; see its docstring for why JAX_PLATFORMS
+alone is not enough."""
 import os
+import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from dragonboat_tpu._jaxenv import pin_cpu
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - plugin absent outside this image
-    pass
+pin_cpu(n_devices=8)
 
 
 def pytest_configure(config):
