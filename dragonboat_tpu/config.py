@@ -71,6 +71,10 @@ class EngineConfig:
     over more protocol work.
     """
 
+    # "vector" = the device-kernel engine (engine/vector.py) advancing all
+    # groups in one compiled step; "scalar" = per-group Python Peer stepping
+    # (engine/execengine.py).
+    kind: str = "scalar"
     # Max Raft groups per NodeHost; the G dimension of the kernel tensors.
     max_groups: int = 1024
     # Max peers per group (incl. self); the P dimension.

@@ -109,8 +109,16 @@ class Node:
         self._snapshot_lock = threading.Lock()
         self._snapshot_in_progress = False
         self._stream_requests: List = []
-        # launch the protocol core
-        self.peer = Peer.launch(
+        # launch the protocol core (VectorNode overrides: its protocol state
+        # lives in the shared device tensors, not a per-group Peer)
+        self.peer = self._launch_core(
+            cfg, log_reader, peer_addresses, initial, new_node, rng
+        )
+        if not self._has_snapshot_to_recover():
+            self.initialized.set()
+
+    def _launch_core(self, cfg, log_reader, peer_addresses, initial, new_node, rng):
+        return Peer.launch(
             cfg,
             log_reader,
             events=self._make_raft_event_adapter(),
@@ -119,8 +127,6 @@ class Node:
             new_node=new_node,
             rng=rng,
         )
-        if not self._has_snapshot_to_recover():
-            self.initialized.set()
 
     # ----------------------------------------------------------------- naming
     def node_id(self) -> int:

@@ -1,0 +1,1675 @@
+"""VectorEngine: the device-kernel-backed execution engine.
+
+The scalar ExecEngine advances each group with a per-group Peer inside
+worker threads (cf. reference execengine.go:474-560). This engine is the
+TPU-first replacement: ALL groups hosted by a NodeHost live as lanes of one
+(G, P) tensor state (ops/state.RaftTensors) and advance together in one
+compiled kernel step (ops/kernel.step_batch). The host side of the engine
+
+  1. packs per-group events (ticks, wire messages, proposals, reads,
+     config changes, transfers) into the device Inbox,
+  2. runs the jitted step,
+  3. fans the StepOutput out with the reference's ordering invariants
+     (cf. execengine.go:474-560): Replicate messages leave BEFORE the
+     fsync; hard state + new entries are persisted in ONE batched
+     save_raft_state call for every lane; responses (vote grants,
+     ReplicateResp) leave only after persistence; committed entries are
+     handed to the RSM task workers after persistence.
+
+Payload bytes never touch the device: the kernel works on (index, term,
+is_cc) metadata while the engine keeps an arena of Entry objects keyed by
+(lane, real index). The kernel reports where each proposal/replicate landed
+(StepOutput.prop_base / rep_base) so the host places payloads at the
+device-assigned indexes without guessing.
+
+Node identity on device is the peer slot (0..P-1). The canonical mapping is
+rank-in-sorted-order of the member node ids, recomputed whenever membership
+changes — a pure function of the (replicated) membership image, so every
+replica derives the same mapping at the same applied index. The wire always
+carries real node ids and real (un-rebased) indexes.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import Config, NodeHostConfig
+from ..core.peer import PeerAddress, encode_config_change
+from ..logger import get_logger
+from ..ops.kernel import make_step_fn
+from ..ops.state import (
+    MSG,
+    NEED_SNAPSHOT,
+    ROLE,
+    RSTATE,
+    SEND_HEARTBEAT,
+    SEND_REPLICATE,
+    SEND_TIMEOUT_NOW,
+    SEND_VOTE_REQ,
+    Inbox,
+    KernelConfig,
+    RaftTensors,
+    init_state,
+    rebase,
+)
+from ..settings import soft
+from ..types import (
+    Entry,
+    EntryType,
+    Message,
+    MessageType,
+    ReadyToRead,
+    Snapshot,
+    State,
+    SystemCtx,
+    Update,
+)
+from .execengine import WorkReady
+from .node import Node
+
+_plog = get_logger("vectorengine")
+
+MT = MessageType
+
+# device index value guard: rebase once any lane's last index crosses this
+_REBASE_THRESHOLD = 1 << 30
+
+# ctx encoding: (origin_slot + 1) << 24 | (ctx.low & 0xFFFFFF); the origin
+# slot rides inside the 31-bit device hint so a leader can route confirmed
+# forwarded reads back to the requesting replica (the reference keeps the
+# requester in the message envelope instead, raft.go:1871-1898)
+_CTX_LOW_MASK = 0xFFFFFF
+
+
+def _enc_ctx(origin_slot: int, low: int) -> int:
+    return ((origin_slot + 1) << 24) | (low & _CTX_LOW_MASK)
+
+
+def _ctx_origin(enc: int) -> int:
+    return (enc >> 24) - 1
+
+
+class VectorNode(Node):
+    """A Node whose protocol core is a lane of the shared device state.
+
+    The public request surface (propose/read/config-change/snapshot/
+    transfer), the RSM manager, the snapshotter drivers and the pending
+    notification machinery are all inherited; only the protocol stepping is
+    different — there is no Peer, the VectorEngine advances every lane in
+    one kernel call.
+    """
+
+    def _launch_core(self, cfg, log_reader, peer_addresses, initial, new_node, rng):
+        self._vec_initial = initial
+        self._vec_new_node = new_node
+        self._vec_addresses = list(peer_addresses)
+        self._status_mu = threading.Lock()
+        self._vstatus = {
+            "leader_id": 0,
+            "term": 0,
+            "state": ROLE.FOLLOWER,
+            "commit": 0,
+        }
+        return None  # no scalar Peer
+
+    # ------------------------------------------------------------ status
+    def get_leader_id(self) -> int:
+        with self._status_mu:
+            return self._vstatus["leader_id"]
+
+    def local_status(self):
+        with self._status_mu:
+            st = dict(self._vstatus)
+        st.update(
+            cluster_id=self.cluster_id,
+            node_id=self._node_id,
+            applied=self.sm.last_applied_index(),
+        )
+        return st
+
+    def _set_status(self, leader_id: int, term: int, role: int, commit: int) -> None:
+        with self._status_mu:
+            prev = self._vstatus["leader_id"], self._vstatus["term"]
+            self._vstatus.update(
+                leader_id=leader_id, term=term, state=role, commit=commit
+            )
+        if prev != (leader_id, term) and self.events is not None:
+            self.events.leader_updated(
+                self.cluster_id, self._node_id, leader_id, term
+            )
+
+    # ------------------------------------------------- INodeProxy overrides
+    def apply_config_change(self, cc) -> None:
+        """A config change committed and passed the membership legality
+        checks: reconcile the device lane (slot remap) on the engine loop."""
+        self.engine.membership_changed(self)
+
+    def config_change_processed(self, key: int, accepted: bool) -> None:
+        self.pending_config_change.apply(key, rejected=not accepted)
+        # the device's single-pending-config-change latch opens once the
+        # change is applied or rejected (cf. raft.go:1242-1295; the scalar
+        # core clears it through apply_config_change/reject_config_change)
+        self.engine.cc_processed(self)
+
+    # --------------------------------------------------- snapshot overrides
+    def _recover_initial_snapshot_locked(self) -> None:
+        from ..rsm import Task
+
+        t = Task(
+            cluster_id=self.cluster_id,
+            node_id=self._node_id,
+            snapshot_available=True,
+        )
+        self.sm.recover_from_snapshot(t)
+
+    def _do_recover_snapshot(self, task) -> None:
+        """InstallSnapshot arrived and the SM recovered from it on a
+        snapshot worker; reconcile the device lane and ack the leader
+        (cf. node.go:950-965 + raft.go handleInstallSnapshotMessage)."""
+        idx = self.sm.recover_from_snapshot(task)
+        if idx > 0:
+            ss = self.snapshotter.get_most_recent_snapshot()
+            if ss is not None and not ss.is_empty():
+                with self._mu:
+                    self.log_reader.apply_snapshot(ss)
+                self.engine.snapshot_restored(self, ss)
+                return
+        self.engine.recover_done(self)
+
+
+class _Lane:
+    """Per-group host bookkeeping owned by the engine loop thread."""
+
+    __slots__ = (
+        "g",
+        "node",
+        "cfg",
+        "base",
+        "slots",
+        "rev",
+        "arena",
+        "staged_props",
+        "staged_reads",
+        "staged_ccs",
+        "msg_backlog",
+        "pack_info",
+        "ri_pending",
+        "recovering",
+        "catchup",
+        "leader_slot",
+        "term",
+        "role",
+        "committed",
+        "last_index",
+        "first_index",
+        "applied_since_snapshot",
+        "snapshot_pending",
+        "active",
+        "cc_inflight",
+    )
+
+    def __init__(self, g: int, node: VectorNode) -> None:
+        self.g = g
+        self.node = node
+        self.cfg: Config = node.config
+        self.base = 0  # real index = device index + base
+        self.slots: Dict[int, int] = {}  # node_id -> slot
+        self.rev: Dict[int, int] = {}  # slot -> node_id
+        self.arena: Dict[int, Entry] = {}  # real index -> Entry
+        self.staged_props: deque = deque()  # (Entry, is_local)
+        self.staged_reads: deque = deque()  # RequestState
+        self.staged_ccs: deque = deque()  # (Entry, key)
+        self.msg_backlog: deque = deque()  # wire Messages awaiting a slot
+        self.pack_info: Dict[int, tuple] = {}
+        self.ri_pending: Dict[int, SystemCtx] = {}  # enc -> real ctx
+        self.recovering = False
+        self.catchup: Dict[int, Tuple[int, int]] = {}  # slot -> (next, goal)
+        self.leader_slot = -1
+        self.term = 0
+        self.role = ROLE.FOLLOWER
+        self.committed = 0
+        self.last_index = 0
+        self.first_index = 1
+        self.applied_since_snapshot = 0
+        self.snapshot_pending = False
+        self.active = False
+        self.cc_inflight = False
+
+    # ------------------------------------------------------- slot mapping
+    def set_slots(self, member_ids) -> Dict[int, int]:
+        """Canonical mapping: rank in sorted member-id order. Returns the
+        old->new slot permutation for device remap."""
+        new = {nid: i for i, nid in enumerate(sorted(member_ids))}
+        perm = {}
+        for nid, old_slot in self.slots.items():
+            if nid in new:
+                perm[old_slot] = new[nid]
+        self.slots = new
+        self.rev = {s: nid for nid, s in new.items()}
+        return perm
+
+    def slot_of(self, node_id: int, provisional: bool = False) -> int:
+        s = self.slots.get(node_id)
+        if s is not None:
+            return s
+        if not provisional:
+            return -1
+        # a sender we have not learned through membership yet (join path):
+        # park it on a free slot; the canonical remap fixes it at apply time
+        P = self.node.engine.kcfg.peers
+        used = set(self.slots.values())
+        for s in range(P):
+            if s not in used:
+                self.slots[node_id] = s
+                self.rev[s] = node_id
+                return s
+        return -1
+
+    def self_slot(self) -> int:
+        return self.slots.get(self.node.node_id(), -1)
+
+
+class VectorEngine:
+    """Engine-compatible facade (add/remove/set_*_ready/stop) around the
+    single-stepper loop that advances all lanes per kernel call."""
+
+    def __init__(
+        self,
+        logdb,
+        nh_config: Optional[NodeHostConfig] = None,
+        num_task_workers: Optional[int] = None,
+        num_snapshot_workers: int = 2,
+    ) -> None:
+        self._logdb = logdb
+        ecfg = nh_config.engine if nh_config is not None else None
+        self.kcfg = KernelConfig(
+            groups=ecfg.max_groups if ecfg else 64,
+            peers=ecfg.max_peers if ecfg else 8,
+            log_window=ecfg.log_window if ecfg else 128,
+            inbox_depth=ecfg.inbox_depth if ecfg else 8,
+            max_entries_per_msg=8,
+            readindex_depth=ecfg.readindex_depth if ecfg else 4,
+        )
+        self._step_fn = make_step_fn(self.kcfg, donate=True)
+        self._state: RaftTensors = init_state(self.kcfg)
+        self._lanes: Dict[int, _Lane] = {}  # cluster_id -> lane
+        self._free = list(range(self.kcfg.groups - 1, -1, -1))
+        self._lanes_mu = threading.RLock()
+        self._reconq: deque = deque()  # host->device ops, loop-applied
+        self._stopped = threading.Event()
+        self._ready = threading.Event()
+        # numpy staging buffers for the inbox (reused across steps)
+        G, K, E = self.kcfg.groups, self.kcfg.inbox_depth, 8
+        self._buf = {
+            "mtype": np.full((G, K), MSG.NONE, np.int32),
+            "from_slot": np.zeros((G, K), np.int32),
+            "term": np.zeros((G, K), np.int32),
+            "log_index": np.zeros((G, K), np.int32),
+            "log_term": np.zeros((G, K), np.int32),
+            "commit": np.zeros((G, K), np.int32),
+            "reject": np.zeros((G, K), bool),
+            "hint": np.zeros((G, K), np.int32),
+            "n_entries": np.zeros((G, K), np.int32),
+            "entry_terms": np.zeros((G, K, E), np.int32),
+            "entry_cc": np.zeros((G, K, E), bool),
+        }
+        self._ticks = np.zeros((G,), np.int32)
+        # worker pools for apply + snapshot work (same split as ExecEngine)
+        self._n_task = num_task_workers or min(
+            soft.step_engine_task_worker_count, 4
+        )
+        self._n_snap = num_snapshot_workers
+        self.task_ready = WorkReady(self._n_task)
+        self.snapshot_ready = WorkReady(self._n_snap)
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._loop, name="vec-step", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self._n_task):
+            t = threading.Thread(
+                target=self._task_worker_main, args=(i,), name=f"vtask-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(self._n_snap):
+            t = threading.Thread(
+                target=self._snapshot_worker_main, args=(i,), name=f"vsnap-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # --------------------------------------------------------- registration
+    def add_node(self, node: VectorNode) -> None:
+        with self._lanes_mu:
+            if not self._free:
+                raise RuntimeError(
+                    f"vector engine lane capacity ({self.kcfg.groups}) exhausted"
+                )
+            g = self._free.pop()
+            lane = _Lane(g, node)
+            self._lanes[node.cluster_id] = lane
+        self._reconq.append(("activate", lane))
+        self.set_node_ready(node.cluster_id)
+
+    def remove_node(self, cluster_id: int) -> None:
+        with self._lanes_mu:
+            lane = self._lanes.pop(cluster_id, None)
+        if lane is not None:
+            self._reconq.append(("deactivate", lane))
+            self._ready.set()
+
+    def get_node(self, cluster_id: int):
+        with self._lanes_mu:
+            lane = self._lanes.get(cluster_id)
+        return lane.node if lane is not None else None
+
+    # -------------------------------------------------------------- wakeups
+    def set_node_ready(self, cluster_id: int) -> None:
+        self._ready.set()
+
+    def set_task_ready(self, cluster_id: int) -> None:
+        self.task_ready.notify(cluster_id)
+
+    def set_snapshot_ready(self, cluster_id: int) -> None:
+        self.snapshot_ready.notify(cluster_id)
+
+    # ------------------------------------------------- host->device bridges
+    def membership_changed(self, node: VectorNode) -> None:
+        """Called on a task worker when a config change applies; the loop
+        recomputes the canonical slot mapping from the SM membership."""
+        self._reconq.append(("membership", node))
+        self._ready.set()
+
+    def snapshot_restored(self, node: VectorNode, ss: Snapshot) -> None:
+        self._reconq.append(("restore", node, ss))
+        self._ready.set()
+
+    def cc_processed(self, node: VectorNode) -> None:
+        self._reconq.append(("cc_done", node))
+        self._ready.set()
+
+    def recover_done(self, node: VectorNode) -> None:
+        self._reconq.append(("recover_done", node))
+        self._ready.set()
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        period = 0.002
+        while not self._stopped.is_set():
+            self._ready.wait(period)
+            self._ready.clear()
+            if self._stopped.is_set():
+                return
+            try:
+                self._run_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _run_once(self) -> None:
+        self._apply_reconciles()
+        with self._lanes_mu:
+            lanes = [ln for ln in self._lanes.values() if ln.active]
+        if not lanes:
+            return
+        had_work = self._pack(lanes)
+        if not had_work:
+            return
+        inbox = Inbox(
+            mtype=jnp.asarray(self._buf["mtype"]),
+            from_slot=jnp.asarray(self._buf["from_slot"]),
+            term=jnp.asarray(self._buf["term"]),
+            log_index=jnp.asarray(self._buf["log_index"]),
+            log_term=jnp.asarray(self._buf["log_term"]),
+            commit=jnp.asarray(self._buf["commit"]),
+            reject=jnp.asarray(self._buf["reject"]),
+            hint=jnp.asarray(self._buf["hint"]),
+            n_entries=jnp.asarray(self._buf["n_entries"]),
+            entry_terms=jnp.asarray(self._buf["entry_terms"]),
+            entry_cc=jnp.asarray(self._buf["entry_cc"]),
+        )
+        ticks = jnp.asarray(self._ticks)
+        self._state, out = self._step_fn(self._state, inbox, ticks)
+        self._decode(lanes, out)
+
+    # ---------------------------------------------------------------- pack
+    def _pack(self, lanes: List[_Lane]) -> bool:
+        K = self.kcfg.inbox_depth
+        E = self.kcfg.max_entries_per_msg
+        buf = self._buf
+        buf["mtype"].fill(MSG.NONE)
+        buf["n_entries"].fill(0)
+        buf["entry_cc"].fill(False)
+        self._ticks.fill(0)
+        had = False
+        for lane in lanes:
+            node = lane.node
+            g = lane.g
+            lane.pack_info = {}
+            msgs, ticks = node.mq.get()
+            if ticks:
+                capped = min(ticks, lane.cfg.election_rtt)
+                self._ticks[g] = capped
+                for _ in range(ticks):
+                    node.clock.increase_tick()
+                    node.pending_proposals.gc()
+                    node.pending_read_indexes.gc()
+                    node.pending_config_change.gc()
+                    node.pending_snapshot.gc()
+                had = True
+            lane.msg_backlog.extend(msgs)
+            if lane.recovering:
+                # an InstallSnapshot recover is in flight: hold everything
+                # until the device lane is reconciled (cf. node.go:1199)
+                continue
+            # drain API queues into the staging deques
+            for e in node.incoming_proposals.get():
+                lane.staged_props.append((e, True))
+            for rs in node.incoming_reads.get():
+                lane.staged_reads.append(rs)
+            with node._mu:
+                ccs, node._cc_queue = node._cc_queue, []
+            for cc, key in ccs:
+                ce = Entry(
+                    type=EntryType.CONFIG_CHANGE,
+                    cmd=encode_config_change(cc),
+                    key=key,
+                )
+                lane.staged_ccs.append((ce, key))
+            k = 0
+            # 1. wire/protocol messages first
+            while lane.msg_backlog and k < K:
+                m = lane.msg_backlog.popleft()
+                k_used = self._pack_wire(lane, m, k)
+                if k_used:
+                    had = True
+                    k += 1
+            is_leader = lane.role == ROLE.LEADER
+            leader_nid = lane.rev.get(lane.leader_slot)
+            # 2. one config change per step (lone message; host invariant)
+            if k < K and lane.staged_ccs and not lane.cc_inflight:
+                if is_leader:
+                    ce, key = lane.staged_ccs.popleft()
+                    self._pack_row(
+                        g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
+                        n_entries=1,
+                    )
+                    buf["entry_cc"][g, k, 0] = True
+                    lane.pack_info[k] = ("cc", ce, key)
+                    lane.cc_inflight = True
+                    had = True
+                    k += 1
+                elif leader_nid is not None and leader_nid != node.node_id():
+                    while lane.staged_ccs:
+                        ce, key = lane.staged_ccs.popleft()
+                        node._send_message(
+                            Message(
+                                type=MT.PROPOSE,
+                                cluster_id=node.cluster_id,
+                                to=leader_nid,
+                                from_=node.node_id(),
+                                entries=[ce],
+                            )
+                        )
+            # 3. proposals
+            if lane.staged_props:
+                if is_leader:
+                    while lane.staged_props and k < K:
+                        ents = []
+                        while lane.staged_props and len(ents) < E:
+                            ents.append(lane.staged_props.popleft()[0])
+                        self._pack_row(
+                            g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
+                            n_entries=len(ents),
+                        )
+                        lane.pack_info[k] = ("prop", ents)
+                        had = True
+                        k += 1
+                elif leader_nid is not None and leader_nid != node.node_id():
+                    ents = [e for e, _ in lane.staged_props]
+                    lane.staged_props.clear()
+                    for i in range(0, len(ents), 64):
+                        node._send_message(
+                            Message(
+                                type=MT.PROPOSE,
+                                cluster_id=node.cluster_id,
+                                to=leader_nid,
+                                from_=node.node_id(),
+                                entries=ents[i : i + 64],
+                            )
+                        )
+            # 4. reads
+            if lane.staged_reads:
+                if is_leader and lane.self_slot() >= 0:
+                    if k < K:
+                        states = list(lane.staged_reads)
+                        lane.staged_reads.clear()
+                        ctx = node.pending_read_indexes.next_ctx()
+                        if node.pending_read_indexes.bind_queued_states(
+                            states, ctx
+                        ):
+                            enc = _enc_ctx(lane.self_slot(), ctx.low)
+                            lane.ri_pending[enc] = ctx
+                            self._pack_row(
+                                g, k, MSG.READ_INDEX,
+                                from_slot=lane.self_slot(), hint=enc,
+                            )
+                            had = True
+                            k += 1
+                elif leader_nid is not None and leader_nid != node.node_id():
+                    states = list(lane.staged_reads)
+                    lane.staged_reads.clear()
+                    ctx = node.pending_read_indexes.next_ctx()
+                    if node.pending_read_indexes.bind_queued_states(states, ctx):
+                        enc = _enc_ctx(lane.self_slot(), ctx.low)
+                        lane.ri_pending[enc] = ctx
+                        node._send_message(
+                            Message(
+                                type=MT.READ_INDEX,
+                                cluster_id=node.cluster_id,
+                                to=leader_nid,
+                                from_=node.node_id(),
+                                hint=enc,
+                            )
+                        )
+            # 5. leadership transfer
+            target = node.pending_leader_transfer.get()
+            if target is not None and k < K:
+                tslot = lane.slots.get(target, -1)
+                if tslot >= 0:
+                    self._pack_row(
+                        g, k, MSG.LEADER_TRANSFER,
+                        from_slot=lane.self_slot(), hint=tslot + 1,
+                    )
+                    had = True
+                    k += 1
+            if lane.catchup:
+                had = True
+        return had
+
+    def _pack_row(
+        self, g: int, k: int, mtype: int, from_slot: int = 0, term: int = 0,
+        log_index: int = 0, log_term: int = 0, commit: int = 0,
+        reject: bool = False, hint: int = 0, n_entries: int = 0,
+    ) -> None:
+        buf = self._buf
+        buf["mtype"][g, k] = mtype
+        buf["from_slot"][g, k] = max(from_slot, 0)
+        buf["term"][g, k] = term
+        buf["log_index"][g, k] = log_index
+        buf["log_term"][g, k] = log_term
+        buf["commit"][g, k] = commit
+        buf["reject"][g, k] = reject
+        buf["hint"][g, k] = hint
+        buf["n_entries"][g, k] = n_entries
+
+    def _pack_wire(self, lane: _Lane, m: Message, k: int) -> bool:
+        """Convert one wire message into an inbox row. Returns False when
+        the message was consumed host-side (snapshot, propose staging)."""
+        g = lane.g
+        t = m.type
+        if t == MT.INSTALL_SNAPSHOT:
+            self._handle_install_snapshot(lane, m)
+            return False
+        if t == MT.PROPOSE:
+            for e in m.entries:
+                if e.type == EntryType.CONFIG_CHANGE:
+                    lane.staged_ccs.append((e, e.key))
+                else:
+                    lane.staged_props.append((e, False))
+            return False
+        if t == MT.QUIESCE:
+            return False
+        from_slot = lane.slot_of(m.from_, provisional=t == MT.REPLICATE or t == MT.HEARTBEAT or t == MT.REQUEST_VOTE or t == MT.TIMEOUT_NOW or t == MT.READ_INDEX_RESP)
+        if from_slot < 0 and m.from_ != 0:
+            return False  # unknown sender and no room to learn it
+        b = lane.base
+        if t == MT.REPLICATE:
+            n = len(m.entries)
+            E = self.kcfg.max_entries_per_msg
+            if n > E:
+                # split: re-queue the tail as a chained Replicate
+                head, tail = m.entries[:E], m.entries[E:]
+                rest = Message(
+                    type=MT.REPLICATE, cluster_id=m.cluster_id, to=m.to,
+                    from_=m.from_, term=m.term, commit=m.commit,
+                    log_index=head[-1].index, log_term=head[-1].term,
+                    entries=tail,
+                )
+                lane.msg_backlog.appendleft(rest)
+                m.entries = head
+                n = E
+            self._pack_row(
+                g, k, MSG.REPLICATE, from_slot=from_slot, term=m.term,
+                log_index=m.log_index - b, log_term=m.log_term,
+                commit=max(m.commit - b, 0), n_entries=n,
+            )
+            for i, e in enumerate(m.entries):
+                self._buf["entry_terms"][g, k, i] = e.term
+                self._buf["entry_cc"][g, k, i] = e.is_config_change()
+            lane.pack_info[k] = ("rep", list(m.entries))
+            return True
+        if t == MT.HEARTBEAT:
+            self._pack_row(
+                g, k, MSG.HEARTBEAT, from_slot=from_slot, term=m.term,
+                commit=max(m.commit - b, 0), hint=m.hint,
+            )
+            return True
+        if t == MT.REQUEST_VOTE:
+            self._pack_row(
+                g, k, MSG.REQUEST_VOTE, from_slot=from_slot, term=m.term,
+                log_index=m.log_index - b, log_term=m.log_term, hint=m.hint,
+            )
+            return True
+        if t == MT.REQUEST_VOTE_RESP:
+            self._pack_row(
+                g, k, MSG.REQUEST_VOTE_RESP, from_slot=from_slot, term=m.term,
+                reject=m.reject,
+            )
+            return True
+        if t == MT.REPLICATE_RESP:
+            self._pack_row(
+                g, k, MSG.REPLICATE_RESP, from_slot=from_slot, term=m.term,
+                log_index=m.log_index - b, reject=m.reject,
+                hint=max(m.hint - b, 0),
+            )
+            return True
+        if t == MT.HEARTBEAT_RESP:
+            self._pack_row(
+                g, k, MSG.HEARTBEAT_RESP, from_slot=from_slot, term=m.term,
+                hint=m.hint,
+            )
+            return True
+        if t == MT.READ_INDEX:
+            self._pack_row(
+                g, k, MSG.READ_INDEX, from_slot=from_slot, term=m.term,
+                hint=m.hint,
+            )
+            return True
+        if t == MT.READ_INDEX_RESP:
+            self._pack_row(
+                g, k, MSG.READ_INDEX_RESP, from_slot=from_slot, term=m.term,
+                log_index=m.log_index - b, hint=m.hint,
+            )
+            return True
+        if t == MT.TIMEOUT_NOW:
+            self._pack_row(
+                g, k, MSG.TIMEOUT_NOW, from_slot=from_slot, term=m.term
+            )
+            return True
+        if t == MT.UNREACHABLE:
+            self._pack_row(g, k, MSG.UNREACHABLE, from_slot=from_slot)
+            return True
+        if t == MT.SNAPSHOT_STATUS:
+            self._pack_row(
+                g, k, MSG.SNAPSHOT_STATUS, from_slot=from_slot, reject=m.reject
+            )
+            return True
+        if t == MT.NOOP:
+            self._pack_row(g, k, MSG.NOOP, from_slot=from_slot, term=m.term)
+            return True
+        return False
+
+    def _handle_install_snapshot(self, lane: _Lane, m: Message) -> None:
+        ss = m.snapshot
+        if ss is None or ss.is_empty():
+            return
+        if ss.index <= lane.node.sm.last_applied_index():
+            return  # stale snapshot
+        lane.recovering = True
+        # persist the snapshot record before recovery (restart safety)
+        self._logdb.save_raft_state(
+            [
+                Update(
+                    cluster_id=lane.node.cluster_id,
+                    node_id=lane.node.node_id(),
+                    snapshot=ss,
+                )
+            ]
+        )
+        lane.node._push_install_snapshot(ss)
+
+    # --------------------------------------------------------------- decode
+    def _decode(self, lanes: List[_Lane], out) -> None:
+        o = {k: np.asarray(v) for k, v in out._asdict().items()}
+        E = self.kcfg.max_entries_per_msg
+        K = self.kcfg.inbox_depth
+        updates: List[Update] = []
+        lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
+        # ---- phase 0: place payloads at device-assigned indexes ----------
+        for lane in lanes:
+            g = lane.g
+            b = lane.base
+            node = lane.node
+            for k, info in lane.pack_info.items():
+                kind = info[0]
+                if kind == "prop":
+                    ents = info[1]
+                    base = int(o["prop_base"][g, k])
+                    if base > 0:
+                        term = int(o["resp_term"][g, k])
+                        for i, e in enumerate(ents):
+                            e.index = b + base + i
+                            e.term = term
+                            lane.arena[e.index] = e
+                    else:
+                        for e in ents:
+                            node.pending_proposals.dropped(e.key)
+                elif kind == "cc":
+                    ce, key = info[1], info[2]
+                    base = int(o["prop_base"][g, k])
+                    stripped = bool(o["dropped_cc"][g])
+                    if base > 0 and not stripped:
+                        ce.index = b + base
+                        ce.term = int(o["resp_term"][g, k])
+                        lane.arena[ce.index] = ce
+                    else:
+                        if base > 0:
+                            # the kernel appended the entry with its cc bit
+                            # stripped (single-pending invariant): it lives
+                            # on as an empty noop entry (raft.go:1587-1606)
+                            lane.arena[b + base] = Entry(
+                                type=EntryType.APPLICATION,
+                                index=b + base,
+                                term=int(o["resp_term"][g, k]),
+                            )
+                        lane.cc_inflight = False
+                        node.pending_config_change.apply(key, rejected=True)
+                elif kind == "rep":
+                    base = int(o["rep_base"][g, k])
+                    if base > 0:
+                        for e in info[1]:
+                            lane.arena[e.index] = e
+            noop_at = int(o["noop_appended"][g])
+            if noop_at > 0:
+                lane.arena[b + noop_at] = Entry(
+                    type=EntryType.APPLICATION,
+                    term=int(o["noop_term"][g]),
+                    index=b + noop_at,
+                )
+            # mirrors
+            lane.leader_slot = int(o["leader"][g]) - 1
+            lane.term = int(o["term"][g])
+            lane.role = int(o["role"][g])
+            lane.committed = b + int(o["commit_index"][g])
+            lane.last_index = b + int(o["last_index"][g])
+            leader_nid = lane.rev.get(lane.leader_slot, 0)
+            node._set_status(leader_nid, lane.term, lane.role, lane.committed)
+        # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
+        send_flags = o["send_flags"]
+        rep_gs, rep_ps = np.nonzero(send_flags & SEND_REPLICATE)
+        by_g = {lane.g: lane for lane in lanes}
+        for g, p in zip(rep_gs.tolist(), rep_ps.tolist()):
+            lane = by_g.get(g)
+            if lane is None:
+                continue
+            to_nid = lane.rev.get(p)
+            if to_nid is None:
+                continue
+            b = lane.base
+            prev = int(o["send_prev_index"][g, p])
+            n = int(o["send_n_entries"][g, p])
+            try:
+                ents = [lane.arena[b + prev + 1 + i] for i in range(n)]
+            except KeyError:
+                _plog.errorf(
+                    "%s missing arena entries for replicate [%d..%d]",
+                    lane.node.describe(), b + prev + 1, b + prev + n,
+                )
+                continue
+            lane.node._send_message(
+                Message(
+                    type=MT.REPLICATE,
+                    cluster_id=lane.node.cluster_id,
+                    to=to_nid,
+                    from_=lane.node.node_id(),
+                    term=int(o["term"][g]),
+                    log_index=b + prev,
+                    log_term=int(o["send_prev_term"][g, p]),
+                    commit=b + int(o["send_commit"][g, p]),
+                    entries=ents,
+                )
+            )
+        # ---- phase 2: one batched fsynced write for every lane -----------
+        for lane in lanes:
+            g = lane.g
+            b = lane.base
+            sf, st_ = int(o["save_from"][g]), int(o["save_to"][g])
+            ents: List[Entry] = []
+            if sf > 0:
+                for idx in range(b + sf, b + st_ + 1):
+                    e = lane.arena.get(idx)
+                    if e is None:
+                        _plog.errorf(
+                            "%s missing arena entry %d for save",
+                            lane.node.describe(), idx,
+                        )
+                        continue
+                    ents.append(e)
+            vote_slot = int(o["vote"][g])
+            state = State(
+                term=int(o["term"][g]),
+                vote=lane.rev.get(vote_slot - 1, 0) if vote_slot > 0 else 0,
+                commit=b + int(o["commit_index"][g]),
+            )
+            if ents or bool(o["hard_changed"][g]):
+                updates.append(
+                    Update(
+                        cluster_id=lane.node.cluster_id,
+                        node_id=lane.node.node_id(),
+                        state=state,
+                        entries_to_save=ents,
+                    )
+                )
+                lane_saves.append((lane, ents, state))
+        if updates:
+            self._logdb.save_raft_state(updates)
+        for lane, ents, state in lane_saves:
+            if ents:
+                lane.node.log_reader.append(ents)
+            lane.node.log_reader.set_state(state)
+        # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
+        for flag, mk in (
+            (SEND_VOTE_REQ, self._mk_vote),
+            (SEND_HEARTBEAT, self._mk_heartbeat),
+            (SEND_TIMEOUT_NOW, self._mk_timeout_now),
+        ):
+            gs, ps = np.nonzero(send_flags & flag)
+            for g, p in zip(gs.tolist(), ps.tolist()):
+                lane = by_g.get(g)
+                if lane is None:
+                    continue
+                to_nid = lane.rev.get(p)
+                if to_nid is None:
+                    continue
+                lane.node._send_message(mk(lane, o, g, p, to_nid))
+        resp_gs, resp_ks = np.nonzero(o["resp_type"] != MSG.NONE)
+        for g, k in zip(resp_gs.tolist(), resp_ks.tolist()):
+            lane = by_g.get(g)
+            if lane is None:
+                continue
+            self._send_resp(lane, o, g, k)
+        # snapshot path for peers that fell behind the device window
+        snap_gs, snap_ps = np.nonzero(send_flags & NEED_SNAPSHOT)
+        for g, p in zip(snap_gs.tolist(), snap_ps.tolist()):
+            lane = by_g.get(g)
+            if lane is not None:
+                self._start_catchup(lane, p, o)
+        # ---- phase 4: hand committed entries to the RSM ------------------
+        for lane in lanes:
+            g = lane.g
+            b = lane.base
+            af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
+            if af <= 0:
+                continue
+            ents = []
+            missing = False
+            for idx in range(b + af, b + at + 1):
+                e = lane.arena.get(idx)
+                if e is None:
+                    _plog.errorf(
+                        "%s missing arena entry %d for apply",
+                        lane.node.describe(), idx,
+                    )
+                    missing = True
+                    break
+                ents.append(e)
+            if missing or not ents:
+                continue
+            from ..rsm import Task
+
+            lane.node.sm.task_queue.add(
+                Task(
+                    cluster_id=lane.node.cluster_id,
+                    node_id=lane.node.node_id(),
+                    entries=ents,
+                )
+            )
+            lane.applied_since_snapshot += len(ents)
+            if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
+                lane.cc_inflight = False
+            self.set_task_ready(lane.node.cluster_id)
+        # ---- phase 5: confirmed reads ------------------------------------
+        for lane in lanes:
+            g = lane.g
+            n = int(o["ready_count"][g])
+            if n == 0:
+                continue
+            node = lane.node
+            for i in range(n):
+                enc = int(o["ready_ctx"][g, i])
+                idx = lane.base + int(o["ready_index"][g, i])
+                origin = _ctx_origin(enc)
+                if origin == lane.self_slot():
+                    ctx = lane.ri_pending.pop(enc, None)
+                    if ctx is not None:
+                        node.pending_read_indexes.add_ready_to_read(
+                            [ReadyToRead(index=idx, system_ctx=ctx)]
+                        )
+                else:
+                    to_nid = lane.rev.get(origin)
+                    if to_nid is not None:
+                        node._send_message(
+                            Message(
+                                type=MT.READ_INDEX_RESP,
+                                cluster_id=node.cluster_id,
+                                to=to_nid,
+                                from_=node.node_id(),
+                                term=lane.term,
+                                log_index=idx,
+                                hint=enc,
+                            )
+                        )
+            node.pending_read_indexes.applied(node.sm.last_applied_index())
+        # ---- phase 6: maintenance ----------------------------------------
+        self._maintain(lanes, o)
+
+    def _mk_vote(self, lane, o, g, p, to_nid) -> Message:
+        return Message(
+            type=MT.REQUEST_VOTE,
+            cluster_id=lane.node.cluster_id,
+            to=to_nid,
+            from_=lane.node.node_id(),
+            term=int(o["term"][g]),
+            log_index=lane.base + int(o["vote_last_index"][g]),
+            log_term=int(o["vote_last_term"][g]),
+            hint=int(o["send_hint"][g, p]),
+        )
+
+    def _mk_heartbeat(self, lane, o, g, p, to_nid) -> Message:
+        return Message(
+            type=MT.HEARTBEAT,
+            cluster_id=lane.node.cluster_id,
+            to=to_nid,
+            from_=lane.node.node_id(),
+            term=int(o["term"][g]),
+            commit=lane.base + int(o["send_hb_commit"][g, p]),
+            hint=int(o["send_hint"][g, p]),
+        )
+
+    def _mk_timeout_now(self, lane, o, g, p, to_nid) -> Message:
+        return Message(
+            type=MT.TIMEOUT_NOW,
+            cluster_id=lane.node.cluster_id,
+            to=to_nid,
+            from_=lane.node.node_id(),
+            term=int(o["term"][g]),
+        )
+
+    def _send_resp(self, lane: _Lane, o, g: int, k: int) -> None:
+        t = int(o["resp_type"][g, k])
+        to_slot = int(o["resp_to"][g, k])
+        to_nid = lane.rev.get(to_slot)
+        if to_nid is None:
+            return
+        if to_nid == lane.node.node_id():
+            return  # self-addressed (e.g. local election artifacts)
+        b = lane.base
+        wire = {
+            MSG.REPLICATE_RESP: MT.REPLICATE_RESP,
+            MSG.REQUEST_VOTE_RESP: MT.REQUEST_VOTE_RESP,
+            MSG.HEARTBEAT_RESP: MT.HEARTBEAT_RESP,
+            MSG.NOOP: MT.NOOP,
+        }.get(t)
+        if wire is None:
+            return
+        log_index = int(o["resp_log_index"][g, k])
+        hint = int(o["resp_hint"][g, k])
+        if wire == MT.REPLICATE_RESP:
+            log_index += b
+            hint += b
+        lane.node._send_message(
+            Message(
+                type=wire,
+                cluster_id=lane.node.cluster_id,
+                to=to_nid,
+                from_=lane.node.node_id(),
+                term=int(o["resp_term"][g, k]),
+                log_index=log_index,
+                reject=bool(o["resp_reject"][g, k]),
+                hint=hint,
+                hint_high=int(o["resp_hint2"][g, k]),
+            )
+        )
+
+    # ------------------------------------------------------ catchup path
+    def _start_catchup(self, lane: _Lane, p: int, o) -> None:
+        """A peer's next index fell behind the device window. If the host
+        log still has the entries, replicate them host-side (the device has
+        parked the peer in SNAPSHOT state; ReplicateResps move match and the
+        kernel un-parks it once caught). Otherwise stream a real snapshot
+        (cf. raft.go:774-785)."""
+        if p in lane.catchup:
+            return
+        g = lane.g
+        goal = lane.base + int(o["last_index"][g])
+        match = lane.base + int(o["match"][g, p])
+        start = match + 1
+        first, last = lane.node.log_reader.get_range()
+        if start >= first and start <= last + 1:
+            # [next_to_send, goal, match_at_last_progress, stall_rounds]
+            lane.catchup[p] = [start, goal, match, 0]
+        else:
+            # the follower needs entries the host log no longer has
+            # (compacted behind a snapshot): only a snapshot can help
+            self._send_snapshot(lane, p)
+
+    def _send_snapshot(self, lane: _Lane, p: int) -> None:
+        to_nid = lane.rev.get(p)
+        if to_nid is None:
+            return
+        ss = lane.node.snapshotter.get_most_recent_snapshot()
+        if ss is None or ss.is_empty():
+            ss = lane.node.log_reader.snapshot()
+        if ss is None or ss.is_empty():
+            _plog.warningf(
+                "%s peer %d needs a snapshot but none exists",
+                lane.node.describe(), to_nid,
+            )
+            return
+        lane.node._send_message(
+            Message(
+                type=MT.INSTALL_SNAPSHOT,
+                cluster_id=lane.node.cluster_id,
+                to=to_nid,
+                from_=lane.node.node_id(),
+                term=lane.term,
+                snapshot=ss,
+            )
+        )
+
+    def _run_catchups(self, lane: _Lane, o) -> None:
+        if not lane.catchup:
+            return
+        g = lane.g
+        done = []
+        for p, cu in lane.catchup.items():
+            nxt, goal, last_match, stall = cu
+            match = lane.base + int(o["match"][g, p])
+            if match >= goal or lane.role != ROLE.LEADER:
+                done.append(p)
+                continue
+            if match > last_match:
+                cu[2], cu[3] = match, 0
+            else:
+                cu[3] = stall + 1
+                if cu[3] > 500:
+                    # the follower stopped acking (divergence, loss): give
+                    # up on log replay and ship a snapshot instead
+                    done.append(p)
+                    self._send_snapshot(lane, p)
+                    continue
+            if match + 1 > nxt:
+                nxt = match + 1
+            first, last = lane.node.log_reader.get_range()
+            if nxt < first:
+                done.append(p)
+                self._send_snapshot(lane, p)
+                continue
+            if nxt > last:
+                continue  # wait for the follower to ack what's in flight
+            hi = min(nxt + self.kcfg.max_entries_per_msg - 1, last, goal)
+            try:
+                ents = lane.node.log_reader.entries(nxt, hi + 1, 1 << 20)
+                prev = nxt - 1
+                prev_term = (
+                    lane.node.log_reader.term(prev) if prev > 0 else 0
+                )
+            except Exception:
+                done.append(p)
+                self._send_snapshot(lane, p)
+                continue
+            if not ents:
+                done.append(p)
+                continue
+            to_nid = lane.rev.get(p)
+            if to_nid is None:
+                done.append(p)
+                continue
+            lane.node._send_message(
+                Message(
+                    type=MT.REPLICATE,
+                    cluster_id=lane.node.cluster_id,
+                    to=to_nid,
+                    from_=lane.node.node_id(),
+                    term=lane.term,
+                    log_index=prev,
+                    log_term=prev_term,
+                    commit=min(lane.committed, ents[-1].index),
+                    entries=ents,
+                )
+            )
+            cu[0] = ents[-1].index + 1
+        for p in done:
+            lane.catchup.pop(p, None)
+
+    # --------------------------------------------------------- maintenance
+    def _maintain(self, lanes: List[_Lane], o) -> None:
+        W = self.kcfg.log_window
+        advance_g: List[int] = []
+        advance_first: List[int] = []
+        advance_term: List[int] = []
+        need_rebase = False
+        for lane in lanes:
+            g = lane.g
+            self._run_catchups(lane, o)
+            # periodic snapshot by applied-entry count (node.go:585-601);
+            # a wedged window forces one regardless of config
+            se = lane.cfg.snapshot_entries
+            log_full = bool(o["log_full"][g])
+            if (
+                (se > 0 and lane.applied_since_snapshot >= se) or log_full
+            ) and not lane.snapshot_pending and lane.node.snapshotter is not None:
+                applied, _ = lane.node.sm.get_last_applied()
+                if applied > 0 and not lane.cfg.is_witness:
+                    lane.snapshot_pending = True
+                    lane.applied_since_snapshot = 0
+                    from ..rsm import SSRequest
+
+                    lane.node.push_take_snapshot_request(SSRequest())
+            # device window compaction: advance first_index once the window
+            # is half full; applied entries are recoverable from the host
+            # log (catchup path) or a snapshot, so the device needs neither
+            used = lane.last_index - (lane.base + lane.first_index) + 1
+            applied, applied_term = lane.node.sm.get_last_applied()
+            target = min(applied, lane.committed)
+            if (used > W // 2 or log_full) and target + 1 > lane.base + lane.first_index:
+                lane.first_index = target - lane.base + 1
+                advance_g.append(g)
+                advance_first.append(lane.first_index)
+                advance_term.append(applied_term)
+                # prune the arena below the window (payloads now live in
+                # logdb/log_reader only)
+                for idx in [i for i in lane.arena if i < target + 1]:
+                    del lane.arena[idx]
+            if lane.last_index - lane.base > _REBASE_THRESHOLD:
+                need_rebase = True
+        if advance_g:
+            G = self.kcfg.groups
+            mask = np.zeros((G,), bool)
+            firsts = np.zeros((G,), np.int32)
+            terms = np.zeros((G,), np.int32)
+            mask[advance_g] = True
+            firsts[advance_g] = advance_first
+            terms[advance_g] = advance_term
+            s = self._state
+            m = jnp.asarray(mask)
+            self._state = s._replace(
+                first_index=jnp.where(m, jnp.asarray(firsts), s.first_index),
+                marker_term=jnp.where(m, jnp.asarray(terms), s.marker_term),
+            )
+        if need_rebase:
+            self._do_rebase(lanes)
+
+    def _do_rebase(self, lanes: List[_Lane]) -> None:
+        """Shift device indexes down so they never near 2**31. The delta is
+        a multiple of W (ring-slot invariant, cf. ops/state.rebase)."""
+        W = self.kcfg.log_window
+        G = self.kcfg.groups
+        delta = np.zeros((G,), np.int32)
+        for lane in lanes:
+            d = ((lane.first_index - 1) // W) * W
+            if d > 0:
+                delta[lane.g] = d
+                lane.base += d
+                lane.first_index -= d
+        if delta.any():
+            self._state = rebase(self._state, jnp.asarray(delta))
+
+    # ----------------------------------------------------------- reconciles
+    def _apply_reconciles(self) -> None:
+        while self._reconq:
+            op = self._reconq.popleft()
+            try:
+                kind = op[0]
+                if kind == "activate":
+                    self._activate(op[1])
+                elif kind == "deactivate":
+                    self._deactivate(op[1])
+                elif kind == "membership":
+                    self._reconcile_membership(op[1])
+                elif kind == "restore":
+                    self._reconcile_restore(op[1], op[2])
+                elif kind == "cc_done":
+                    lane = self._lane_of(op[1])
+                    if lane is not None and lane.active:
+                        s = self._state
+                        self._state = s._replace(
+                            pending_cc=s.pending_cc.at[lane.g].set(False)
+                        )
+                        lane.cc_inflight = False
+                elif kind == "recover_done":
+                    lane = self._lane_of(op[1])
+                    if lane is not None:
+                        lane.recovering = False
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _lane_of(self, node) -> Optional[_Lane]:
+        with self._lanes_mu:
+            return self._lanes.get(node.cluster_id)
+
+    def _activate(self, lane: _Lane) -> None:
+        """Bring a lane live: bootstrap (initial start), restart replay, or
+        join-as-empty. Mirrors Peer.launch + node.replayLog
+        (cf. core/peer.py:75-94, node.go:553-583)."""
+        node = lane.node
+        node.recover_initial_snapshot()
+        cfg = lane.cfg
+        g = lane.g
+        W = self.kcfg.log_window
+        P = self.kcfg.peers
+        # membership sources: SM image (restart w/ snapshot) else bootstrap
+        mem = node.sm.get_membership()
+        member_ids = set(mem.addresses) | set(mem.observers) | set(mem.witnesses)
+        if not member_ids:
+            member_ids = {a.node_id for a in node._vec_addresses}
+        bootstrap = node._vec_initial and node._vec_new_node
+        lane.set_slots(member_ids)
+        self_slot = lane.self_slot()
+        if self_slot < 0 and node.node_id() not in lane.slots:
+            # join path: self not yet in membership; park on a free slot
+            self_slot = lane.slot_of(node.node_id(), provisional=True)
+        obs_ids = set(mem.observers)
+        wit_ids = set(mem.witnesses)
+        if not mem.addresses and bootstrap:
+            obs_ids, wit_ids = set(), set()
+        # persisted protocol state
+        st = self._logdb_state(node)
+        snap = node.snapshotter.get_most_recent_snapshot() if node.snapshotter else None
+        snap_index = snap.index if snap is not None and not snap.is_empty() else 0
+        first, last = node.log_reader.get_range()
+        ents: List[Entry] = []
+        if last >= first and last > 0:
+            try:
+                ents = node.log_reader.entries(first, last + 1, 1 << 30)
+            except Exception:
+                ents = []
+        term = st.term
+        vote_nid = st.vote
+        committed = st.commit
+        if bootstrap and not ents:
+            # initial start: membership enters the log as config-change
+            # entries at term 1, committed immediately (core/peer.py:273-294)
+            addrs = sorted(node._vec_addresses, key=lambda a: a.node_id)
+            from ..types import ConfigChange, ConfigChangeType
+
+            for i, pa in enumerate(addrs):
+                cc = ConfigChange(
+                    type=ConfigChangeType.ADD_NODE,
+                    node_id=pa.node_id,
+                    initialize=True,
+                    address=pa.address,
+                )
+                ents.append(
+                    Entry(
+                        type=EntryType.CONFIG_CHANGE,
+                        term=1,
+                        index=i + 1,
+                        cmd=encode_config_change(cc),
+                    )
+                )
+            committed = len(ents)
+            term = max(term, 1)
+        elif node._vec_new_node and not cfg.is_observer and not cfg.is_witness:
+            term = max(term, 1)
+        base = snap_index
+        lane.base = base
+        last_real = ents[-1].index if ents else max(snap_index, last if last else 0)
+        dev_last = max(last_real - base, 0)
+        dev_first = max(dev_last - W + 1, 1)
+        lane.first_index = dev_first
+        lane.committed = max(committed, snap_index)
+        lane.last_index = last_real
+        # ring metadata from the replayed entries
+        ring_terms = np.zeros((W,), np.int32)
+        ring_cc = np.zeros((W,), bool)
+        for e in ents:
+            lane.arena[e.index] = e
+            di = e.index - base
+            if dev_first <= di <= dev_last:
+                ring_terms[di % W] = e.term
+                ring_cc[di % W] = e.type == EntryType.CONFIG_CHANGE
+        marker = dev_first - 1
+        if marker == 0:
+            marker_term = snap.term if snap_index and base == snap_index else 0
+        else:
+            try:
+                marker_term = node.log_reader.term(base + marker)
+            except Exception:
+                marker_term = 0
+        member = np.zeros((P,), bool)
+        voting = np.zeros((P,), bool)
+        observer = np.zeros((P,), bool)
+        witness = np.zeros((P,), bool)
+        for nid, slot in lane.slots.items():
+            if slot >= P:
+                continue
+            member[slot] = True
+            if nid in obs_ids:
+                observer[slot] = True
+            elif nid in wit_ids:
+                witness[slot] = True
+                voting[slot] = True
+            else:
+                voting[slot] = True
+        role = (
+            ROLE.OBSERVER if cfg.is_observer
+            else ROLE.WITNESS if cfg.is_witness
+            else ROLE.FOLLOWER
+        )
+        vote_slot = lane.slots.get(vote_nid, -1)
+        s = self._state
+        seed = int(np.asarray(s.seed[g]))
+        from ..ops.state import _mix
+
+        et = max(cfg.election_rtt, 3)
+        hb = max(cfg.heartbeat_rtt, 1)
+        upd = dict(
+            active=s.active.at[g].set(True),
+            self_slot=s.self_slot.at[g].set(max(self_slot, 0)),
+            member=s.member.at[g].set(jnp.asarray(member)),
+            voting=s.voting.at[g].set(jnp.asarray(voting)),
+            observer=s.observer.at[g].set(jnp.asarray(observer)),
+            witness=s.witness.at[g].set(jnp.asarray(witness)),
+            term=s.term.at[g].set(term),
+            vote=s.vote.at[g].set(vote_slot + 1 if vote_slot >= 0 else 0),
+            role=s.role.at[g].set(role),
+            leader=s.leader.at[g].set(0),
+            tick_count=s.tick_count.at[g].set(0),
+            election_tick=s.election_tick.at[g].set(0),
+            heartbeat_tick=s.heartbeat_tick.at[g].set(0),
+            election_timeout=s.election_timeout.at[g].set(et),
+            heartbeat_timeout=s.heartbeat_timeout.at[g].set(hb),
+            rand_timeout=s.rand_timeout.at[g].set(
+                et + _mix(seed, term, max(self_slot, 0)) % et
+            ),
+            check_quorum=s.check_quorum.at[g].set(cfg.check_quorum),
+            first_index=s.first_index.at[g].set(dev_first),
+            marker_term=s.marker_term.at[g].set(marker_term),
+            last_index=s.last_index.at[g].set(dev_last),
+            committed=s.committed.at[g].set(lane.committed - base),
+            processed=s.processed.at[g].set(max(snap_index - base, 0)),
+            applied=s.applied.at[g].set(max(snap_index - base, 0)),
+            unsaved_from=s.unsaved_from.at[g].set(
+                1 if bootstrap else dev_last + 1
+            ),
+            log_term=s.log_term.at[g].set(jnp.asarray(ring_terms)),
+            log_is_cc=s.log_is_cc.at[g].set(jnp.asarray(ring_cc)),
+            match=s.match.at[g].set(0),
+            next=s.next.at[g].set(dev_last + 1),
+            rstate=s.rstate.at[g].set(RSTATE.RETRY),
+            ract=s.ract.at[g].set(False),
+            snap_sent=s.snap_sent.at[g].set(0),
+            vresp=s.vresp.at[g].set(False),
+            vgrant=s.vgrant.at[g].set(False),
+            transfer_to=s.transfer_to.at[g].set(0),
+            transfer_flag=s.transfer_flag.at[g].set(False),
+            pending_cc=s.pending_cc.at[g].set(False),
+            ri_ctx=s.ri_ctx.at[g].set(0),
+            ri_index=s.ri_index.at[g].set(0),
+            ri_acks=s.ri_acks.at[g].set(0),
+            ri_count=s.ri_count.at[g].set(0),
+        )
+        self._state = s._replace(**upd)
+        lane.active = True
+        self._ready.set()
+
+    def _logdb_state(self, node) -> State:
+        st, _ = node.log_reader.node_state()
+        return st if st is not None else State()
+
+    def _deactivate(self, lane: _Lane) -> None:
+        s = self._state
+        self._state = s._replace(active=s.active.at[lane.g].set(False))
+        lane.active = False
+        with self._lanes_mu:
+            self._free.append(lane.g)
+
+    def _reconcile_membership(self, node) -> None:
+        """Recompute the canonical slot mapping from the applied membership
+        image and permute the per-peer device state accordingly."""
+        lane = self._lane_of(node)
+        if lane is None or not lane.active:
+            return
+        mem = node.sm.get_membership()
+        member_ids = set(mem.addresses) | set(mem.observers) | set(mem.witnesses)
+        if not member_ids:
+            return
+        P = self.kcfg.peers
+        g = lane.g
+        old_rev = dict(lane.rev)
+        perm = lane.set_slots(member_ids)
+        s = self._state
+        # permute [P]-indexed rows: value at old slot moves to new slot
+        def permute_row(row, default):
+            vals = np.asarray(row)
+            out = np.full_like(vals, default)
+            for old, new in perm.items():
+                if old < P and new < P:
+                    out[new] = vals[old]
+            return out
+
+        member = np.zeros((P,), bool)
+        voting = np.zeros((P,), bool)
+        observer = np.zeros((P,), bool)
+        witness = np.zeros((P,), bool)
+        for nid, slot in lane.slots.items():
+            if slot >= P:
+                continue
+            member[slot] = True
+            if nid in mem.observers:
+                observer[slot] = True
+            elif nid in mem.witnesses:
+                witness[slot] = True
+                voting[slot] = True
+            else:
+                voting[slot] = True
+        dev_last = int(np.asarray(s.last_index[g]))
+        match = permute_row(s.match[g], 0)
+        nxt = permute_row(s.next[g], dev_last + 1)
+        nxt = np.maximum(nxt, 1)
+        rstate = permute_row(s.rstate[g], RSTATE.RETRY)
+        ract = permute_row(s.ract[g], False)
+        snap_sent = permute_row(s.snap_sent[g], 0)
+        vresp = permute_row(s.vresp[g], False)
+        vgrant = permute_row(s.vgrant[g], False)
+
+        def remap_ref(v):
+            # slot+1 encoded references (leader/vote/transfer)
+            v = int(np.asarray(v))
+            if v <= 0:
+                return 0
+            new = perm.get(v - 1)
+            return new + 1 if new is not None else 0
+
+        self_slot = lane.self_slot()
+        if self_slot < 0:
+            self_slot = lane.slot_of(node.node_id(), provisional=True)
+        upd = dict(
+            member=s.member.at[g].set(jnp.asarray(member)),
+            voting=s.voting.at[g].set(jnp.asarray(voting)),
+            observer=s.observer.at[g].set(jnp.asarray(observer)),
+            witness=s.witness.at[g].set(jnp.asarray(witness)),
+            self_slot=s.self_slot.at[g].set(max(self_slot, 0)),
+            leader=s.leader.at[g].set(remap_ref(s.leader[g])),
+            vote=s.vote.at[g].set(remap_ref(s.vote[g])),
+            transfer_to=s.transfer_to.at[g].set(remap_ref(s.transfer_to[g])),
+            match=s.match.at[g].set(jnp.asarray(match)),
+            next=s.next.at[g].set(jnp.asarray(nxt)),
+            rstate=s.rstate.at[g].set(jnp.asarray(rstate)),
+            ract=s.ract.at[g].set(jnp.asarray(ract)),
+            snap_sent=s.snap_sent.at[g].set(jnp.asarray(snap_sent)),
+            vresp=s.vresp.at[g].set(jnp.asarray(vresp)),
+            vgrant=s.vgrant.at[g].set(jnp.asarray(vgrant)),
+            # ack bitmasks are slot-indexed: clear and let heartbeats
+            # re-confirm (membership changes are rare)
+            ri_acks=s.ri_acks.at[g].set(0),
+        )
+        self._state = s._replace(**upd)
+        # catchup/leader mirrors use slots: remap
+        lane.catchup = {
+            perm[p]: v for p, v in lane.catchup.items() if p in perm
+        }
+        if lane.leader_slot >= 0:
+            lane.leader_slot = perm.get(lane.leader_slot, -1)
+
+    def _reconcile_restore(self, node, ss: Snapshot) -> None:
+        """An InstallSnapshot finished recovering: rebuild the lane at the
+        snapshot point (cf. raft.go:439-517 restore + restoreRemotes)."""
+        lane = self._lane_of(node)
+        if lane is None:
+            return
+        g = lane.g
+        P = self.kcfg.peers
+        W = self.kcfg.log_window
+        mem = ss.membership or node.sm.get_membership()
+        member_ids = set(mem.addresses) | set(mem.observers) | set(mem.witnesses)
+        lane.set_slots(member_ids)
+        lane.base = ss.index
+        lane.first_index = 1
+        lane.committed = ss.index
+        lane.last_index = ss.index
+        lane.arena = {}
+        lane.catchup = {}
+        member = np.zeros((P,), bool)
+        voting = np.zeros((P,), bool)
+        observer = np.zeros((P,), bool)
+        witness = np.zeros((P,), bool)
+        for nid, slot in lane.slots.items():
+            if slot >= P:
+                continue
+            member[slot] = True
+            if nid in mem.observers:
+                observer[slot] = True
+            elif nid in mem.witnesses:
+                witness[slot] = True
+                voting[slot] = True
+            else:
+                voting[slot] = True
+        self_slot = lane.self_slot()
+        if self_slot < 0:
+            self_slot = lane.slot_of(node.node_id(), provisional=True)
+        s = self._state
+        term = max(int(np.asarray(s.term[g])), ss.term)
+        upd = dict(
+            member=s.member.at[g].set(jnp.asarray(member)),
+            voting=s.voting.at[g].set(jnp.asarray(voting)),
+            observer=s.observer.at[g].set(jnp.asarray(observer)),
+            witness=s.witness.at[g].set(jnp.asarray(witness)),
+            self_slot=s.self_slot.at[g].set(max(self_slot, 0)),
+            term=s.term.at[g].set(term),
+            first_index=s.first_index.at[g].set(1),
+            marker_term=s.marker_term.at[g].set(ss.term),
+            last_index=s.last_index.at[g].set(0),
+            committed=s.committed.at[g].set(0),
+            processed=s.processed.at[g].set(0),
+            applied=s.applied.at[g].set(0),
+            unsaved_from=s.unsaved_from.at[g].set(1),
+            log_term=s.log_term.at[g].set(jnp.zeros((W,), jnp.int32)),
+            log_is_cc=s.log_is_cc.at[g].set(jnp.zeros((W,), bool)),
+            match=s.match.at[g].set(0),
+            next=s.next.at[g].set(1),
+            rstate=s.rstate.at[g].set(RSTATE.RETRY),
+            snap_sent=s.snap_sent.at[g].set(0),
+            ri_ctx=s.ri_ctx.at[g].set(0),
+            ri_index=s.ri_index.at[g].set(0),
+            ri_acks=s.ri_acks.at[g].set(0),
+            ri_count=s.ri_count.at[g].set(0),
+        )
+        self._state = s._replace(**upd)
+        lane.recovering = False
+        # persist the post-restore hard state and ack the leader so its
+        # remote leaves the Snapshot state (raft.go handleInstallSnapshot)
+        self._logdb.save_raft_state(
+            [
+                Update(
+                    cluster_id=node.cluster_id,
+                    node_id=node.node_id(),
+                    state=State(term=term, vote=0, commit=ss.index),
+                )
+            ]
+        )
+        leader = lane.rev.get(lane.leader_slot)
+        sender = leader if leader and leader != node.node_id() else None
+        if sender is None:
+            # best effort: ack every voting peer; only the leader cares
+            senders = [n for n in lane.slots if n != node.node_id()]
+        else:
+            senders = [sender]
+        for nid in senders:
+            node._send_message(
+                Message(
+                    type=MT.REPLICATE_RESP,
+                    cluster_id=node.cluster_id,
+                    to=nid,
+                    from_=node.node_id(),
+                    term=term,
+                    log_index=ss.index,
+                )
+            )
+
+    # --------------------------------------------------------- worker mains
+    def _task_worker_main(self, worker: int) -> None:
+        batch: list = []
+        apply: list = []
+        while not self._stopped.is_set():
+            cids = self.task_ready.wait_and_take(worker)
+            if not cids:
+                continue
+            for cid in cids:
+                node = self.get_node(cid)
+                if node is None or node.stopped:
+                    continue
+                try:
+                    node.handle_task(batch, apply)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                if node.sm.task_queue.size() > 0:
+                    self.set_task_ready(cid)
+
+    def _snapshot_worker_main(self, worker: int) -> None:
+        while not self._stopped.is_set():
+            cids = self.snapshot_ready.wait_and_take(worker)
+            if not cids:
+                continue
+            for cid in cids:
+                node = self.get_node(cid)
+                if node is None or node.stopped:
+                    continue
+                try:
+                    node.run_snapshot_work()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                lane = self._lane_of(node)
+                if lane is not None:
+                    lane.snapshot_pending = False
+
+    # --------------------------------------------------------------- control
+    def stop(self) -> None:
+        self._stopped.set()
+        self._ready.set()
+        self.task_ready.wake_all()
+        self.snapshot_ready.wake_all()
+        # the step thread must fully drain its in-flight iteration before
+        # the caller closes the logdb under it; a short join here would let
+        # a slow device step race the close (observed as "write to closed
+        # file" + a C++ abort at interpreter teardown)
+        for t in self._threads:
+            t.join(timeout=30 if t.name == "vec-step" else 2)
+
+
+__all__ = ["VectorEngine", "VectorNode"]

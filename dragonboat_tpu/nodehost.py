@@ -127,7 +127,12 @@ class NodeHost(IMessageHandler):
             8
         )  # cap concurrent outbound streams (cf. StreamConnections)
         # --- engine
-        self.engine = ExecEngine(self.logdb)
+        if cfg.engine.kind == "vector":
+            from .engine.vector import VectorEngine
+
+            self.engine = VectorEngine(self.logdb, nh_config=cfg)
+        else:
+            self.engine = ExecEngine(self.logdb)
         # --- tick loop
         self._tick_ms = cfg.rtt_millisecond
         self._tick_thread = threading.Thread(
@@ -213,7 +218,13 @@ class NodeHost(IMessageHandler):
         ss = snapshotter.get_most_recent_snapshot()
         if not new_node or (ss is not None and not ss.is_empty()):
             log_reader.load(ss)
-        node = Node(
+        if self.config.engine.kind == "vector":
+            from .engine.vector import VectorNode
+
+            node_cls = VectorNode
+        else:
+            node_cls = Node
+        node = node_cls(
             cfg,
             peer_addresses,
             initial=bool(initial_members) and new_node,

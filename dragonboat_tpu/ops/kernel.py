@@ -237,6 +237,9 @@ def _campaign(s: RaftTensors, mask, out, transfer_hint) -> Tuple[RaftTensors, di
     )
     out = dict(out, send_flags=flags, send_hint=hint)
     out["noop_appended"] = jnp.maximum(out["noop_appended"], noop_at)
+    out["noop_term"] = jnp.maximum(
+        out["noop_term"], jnp.where(single, ns.term, 0)
+    )
     return ns, out
 
 
@@ -353,12 +356,18 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     noop_at = jnp.where(win, s.last_index + 1, 0)
     s = _become_leader(s, win)
     out["noop_appended"] = jnp.maximum(out["noop_appended"], noop_at)
+    out["noop_term"] = jnp.maximum(out["noop_term"], jnp.where(win, s.term, 0))
     s = _become_follower(s, lose, s.term, jnp.zeros_like(s.leader))
 
     # ---- Election / TimeoutNow --------------------------------------------
     ele = act & (mtype == MSG.ELECTION)
     tno = act & (mtype == MSG.TIMEOUT_NOW) & is_fol
     s, out = _campaign(s, ele | tno, out, transfer_hint=tno)
+
+    # per-slot append bases reported to the engine so the host can place
+    # payload bytes at the device-assigned indexes without guessing
+    prop_base = jnp.zeros_like(mterm)
+    rep_base = jnp.zeros_like(mterm)
 
     # ---- Replicate (non-leader) -------------------------------------------
     rep = act & (mtype == MSG.REPLICATE) & (is_fol | is_obs | is_wit | is_cand)
@@ -411,6 +420,7 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     ack_to = prev + nent
     new_commit = jnp.clip(jnp.minimum(ack_to, m["commit"]), s.committed, s.last_index)
     s = s._replace(committed=jnp.where(ok, new_commit, s.committed))
+    rep_base = jnp.where(ok, prev + 1, rep_base)
     resp_type = jnp.where(rep, MSG.REPLICATE_RESP, resp_type)
     resp_log_index = jnp.where(
         stale, s.committed, jnp.where(ok, ack_to, jnp.where(rej, prev, resp_log_index))
@@ -569,6 +579,7 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     out["dropped_cc"] = out["dropped_cc"] | cc_stripped
     room = s.last_index - s.first_index + 1 + nent <= W
     can_append = pok & room
+    prop_base = jnp.where(can_append, s.last_index + 1, prop_base)
     # append up to E entries at the current term
     if E > 0:
         a_idx = s.last_index[:, None] + 1 + jnp.arange(E, dtype=i32)[None, :]
@@ -658,6 +669,8 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
         "resp_reject": resp_reject,
         "resp_hint": resp_hint,
         "resp_hint2": resp_hint2,
+        "prop_base": prop_base,
+        "rep_base": rep_base,
     }
     return s, out, resps
 
@@ -742,6 +755,7 @@ def step_batch(
         "send_flags": jnp.zeros((G, P), i32),
         "send_hint": jnp.zeros((G, P), i32),
         "noop_appended": jnp.zeros((G,), i32),
+        "noop_term": jnp.zeros((G,), i32),
         "dropped_propose": jnp.zeros((G,), i32),
         "dropped_readindex": jnp.zeros((G,), i32),
         "dropped_cc": jnp.zeros((G,), bool),
@@ -917,6 +931,19 @@ def step_batch(
 
     last_term_out = _term_at(s, s.last_index)
 
+    # suppress send directives whose issuing role died mid-step: a lane that
+    # was leader during the tick phase but stepped down while draining the
+    # inbox must not emit leader traffic stamped with its new term (the
+    # scalar core sequences message creation with state changes; here the
+    # planes are assembled at step end, so the end-of-step role gates them)
+    leader_bits = SEND_REPLICATE | SEND_HEARTBEAT | SEND_TIMEOUT_NOW | NEED_SNAPSHOT
+    end_leader = (s.role == ROLE.LEADER)[:, None]
+    end_cand = (s.role == ROLE.CANDIDATE)[:, None]
+    flags = out["send_flags"]
+    flags = jnp.where(end_leader, flags, flags & ~leader_bits)
+    flags = jnp.where(end_cand, flags, flags & ~SEND_VOTE_REQ)
+    out["send_flags"] = flags
+
     output = StepOutput(
         send_flags=out["send_flags"] * s.active[:, None],
         send_prev_index=send_prev_index,
@@ -947,7 +974,16 @@ def step_batch(
         dropped_cc=out["dropped_cc"],
         fwd_leader=out["fwd_leader"],
         noop_appended=out["noop_appended"],
+        noop_term=out["noop_term"],
         log_full=out["log_full"],
+        prop_base=resps["prop_base"],
+        rep_base=resps["rep_base"],
+        leader=s.leader,
+        term=s.term,
+        vote=s.vote,
+        role=s.role,
+        match=s.match,
+        last_index=s.last_index,
     )
     return s, output
 

@@ -200,7 +200,20 @@ class StepOutput(NamedTuple):
     dropped_cc: jax.Array  # bool[G] config-change replaced (pending invariant)
     fwd_leader: jax.Array  # i32[G] slot+1 to forward host proposals to
     noop_appended: jax.Array  # i32[G] index of new-leader noop entry (0=none)
+    noop_term: jax.Array  # i32[G] term of that noop entry (0=none)
     log_full: jax.Array  # bool[G] window exhausted; engine must snapshot
+    # per-inbox-slot append bases (0 = message appended nothing): the host
+    # places payload bytes at these device-assigned indexes
+    prop_base: jax.Array  # i32[G,K] first index appended for a PROPOSE slot
+    rep_base: jax.Array  # i32[G,K] first entry index of an accepted Replicate
+    # post-step state mirror for the host engine (leader/term tracking,
+    # status queries, host-side catch-up of lagging peers)
+    leader: jax.Array  # i32[G] slot+1, 0=none
+    term: jax.Array  # i32[G]
+    vote: jax.Array  # i32[G] slot+1, 0=none (for hard-state persistence)
+    role: jax.Array  # i32[G] ROLE.*
+    match: jax.Array  # i32[G,P]
+    last_index: jax.Array  # i32[G]
 
 
 def init_state(cfg: KernelConfig) -> RaftTensors:

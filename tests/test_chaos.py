@@ -19,7 +19,7 @@ import time
 
 import pytest
 
-from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
 from dragonboat_tpu.lincheck import HistoryRecorder, check_kv_history
 from dragonboat_tpu.nodehost import NodeHost
 from dragonboat_tpu.requests import RequestError
@@ -58,12 +58,15 @@ class HashKV(IStateMachine):
         self.d = json.loads(r.read().decode())
 
 
-def _mk_host(nid, reg, tmp):
+def _mk_host(nid, reg, tmp, engine_kind="scalar"):
     cfg = NodeHostConfig(
         deployment_id=3, rtt_millisecond=5,
         nodehost_dir=f"{tmp}/h{nid}",
         raft_address=f"c{nid}:1",
         raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+        engine=EngineConfig(
+            kind=engine_kind, max_groups=32, max_peers=4, log_window=64
+        ),
     )
     nh = NodeHost(cfg)
     members = {h: f"c{h}:1" for h in HOSTS}
@@ -94,10 +97,13 @@ def _find_leader(hosts, deadline_s=20):
 
 
 @pytest.mark.slow
-def test_chaos_linearizable_and_converged(tmp_path):
+@pytest.mark.parametrize("engine_kind", ["scalar", "vector"])
+def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
     rng = random.Random(0xD5A60)
     reg = _Registry()
-    hosts = {nid: _mk_host(nid, reg, str(tmp_path)) for nid in HOSTS}
+    hosts = {
+        nid: _mk_host(nid, reg, str(tmp_path), engine_kind) for nid in HOSTS
+    }
     rec = HistoryRecorder()
     stop = threading.Event()
     seq = [0]
@@ -178,7 +184,7 @@ def test_chaos_linearizable_and_converged(tmp_path):
             hosts[victim] = None
             nh.stop()
             time.sleep(rng.uniform(0.1, 0.3))
-            hosts[victim] = _mk_host(victim, reg, str(tmp_path))
+            hosts[victim] = _mk_host(victim, reg, str(tmp_path), engine_kind)
         else:
             time.sleep(0.3)
 
@@ -191,7 +197,7 @@ def test_chaos_linearizable_and_converged(tmp_path):
             hosts[nid].set_partitioned(False)
             hosts[nid].transport.set_pre_send_batch_hook(None)
         else:
-            hosts[nid] = _mk_host(nid, reg, str(tmp_path))
+            hosts[nid] = _mk_host(nid, reg, str(tmp_path), engine_kind)
 
     # one final write forces convergence of the commit index; leadership can
     # still be settling right after the fault phase, so retry across hosts

@@ -1,16 +1,24 @@
 """End-to-end NodeHost tests: the minimum slice from SURVEY.md §7 step 3 —
 propose → step → commit → apply → notify on single- and multi-replica
-deployments over the loopback transport (cf. nodehost_test.go patterns)."""
+deployments over the loopback transport (cf. nodehost_test.go patterns).
+
+Every test runs twice: once with the scalar per-group engine and once with
+the vector engine (the device kernel advancing all groups per step)."""
 import threading
 import time
 
 import pytest
 
-from dragonboat_tpu.config import Config, NodeHostConfig
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
 from dragonboat_tpu.nodehost import NodeHost
 from dragonboat_tpu.requests import ErrRejected, ErrTimeout
 from dragonboat_tpu.statemachine import IStateMachine, Result
 from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+
+@pytest.fixture(params=["scalar", "vector"])
+def engine_kind(request):
+    return request.param
 
 
 class KVSM(IStateMachine):
@@ -45,13 +53,18 @@ class KVSM(IStateMachine):
         self.data, self.update_count = json.loads(r.read().decode())
 
 
-def mk_nodehost(addr, registry, rtt_ms=5, nodehost_dir=""):
+def mk_nodehost(addr, registry, rtt_ms=5, nodehost_dir="", engine_kind="scalar"):
     cfg = NodeHostConfig(
         deployment_id=1,
         rtt_millisecond=rtt_ms,
         raft_address=addr,
         nodehost_dir=nodehost_dir,
         raft_rpc_factory=lambda listen: loopback_factory(listen, registry),
+        # one canonical shape for every vector-engine test so the whole
+        # suite shares a single compiled kernel (make_step_fn lru cache)
+        engine=EngineConfig(
+            kind=engine_kind, max_groups=32, max_peers=4, log_window=64
+        ),
     )
     return NodeHost(cfg)
 
@@ -82,9 +95,9 @@ def clear_instances():
     KVSM.instances = []
 
 
-def test_single_node_propose_and_read():
+def test_single_node_propose_and_read(engine_kind):
     reg = _Registry()
-    nh = mk_nodehost("a:1", reg)
+    nh = mk_nodehost("a:1", reg, engine_kind=engine_kind)
     try:
         nh.start_cluster({1: "a:1"}, False, KVSM, group_config(100, 1))
         assert wait_for(lambda: nh.get_leader_id(100)[1])
@@ -100,10 +113,10 @@ def test_single_node_propose_and_read():
         nh.stop()
 
 
-def test_three_replicas_replicate():
+def test_three_replicas_replicate(engine_kind):
     reg = _Registry()
     members = {1: "a:1", 2: "b:2", 3: "c:3"}
-    nhs = [mk_nodehost(addr, reg) for addr in members.values()]
+    nhs = [mk_nodehost(addr, reg, engine_kind=engine_kind) for addr in members.values()]
     try:
         for nid, nh in zip(members, nhs):
             nh.start_cluster(members, False, KVSM, group_config(5, nid))
@@ -136,9 +149,9 @@ def test_three_replicas_replicate():
             nh.stop()
 
 
-def test_many_groups_one_nodehost():
+def test_many_groups_one_nodehost(engine_kind):
     reg = _Registry()
-    nh = mk_nodehost("a:1", reg)
+    nh = mk_nodehost("a:1", reg, engine_kind=engine_kind)
     n_groups = 16
     try:
         for g in range(1, n_groups + 1):
@@ -156,9 +169,9 @@ def test_many_groups_one_nodehost():
         nh.stop()
 
 
-def test_session_dedup_e2e():
+def test_session_dedup_e2e(engine_kind):
     reg = _Registry()
-    nh = mk_nodehost("a:1", reg)
+    nh = mk_nodehost("a:1", reg, engine_kind=engine_kind)
     try:
         nh.start_cluster({1: "a:1"}, False, KVSM, group_config(7, 1))
         assert wait_for(lambda: nh.get_leader_id(7)[1])
@@ -184,10 +197,10 @@ def test_session_dedup_e2e():
         nh.stop()
 
 
-def test_membership_change_e2e():
+def test_membership_change_e2e(engine_kind):
     reg = _Registry()
     members = {1: "a:1", 2: "b:2", 3: "c:3"}
-    nhs = {nid: mk_nodehost(addr, reg) for nid, addr in members.items()}
+    nhs = {nid: mk_nodehost(addr, reg, engine_kind=engine_kind) for nid, addr in members.items()}
     try:
         for nid in (1, 2):
             nhs[nid].start_cluster(
@@ -223,10 +236,10 @@ def test_membership_change_e2e():
             nh.stop()
 
 
-def test_restart_replay(tmp_path):
+def test_restart_replay(tmp_path, engine_kind):
     reg = _Registry()
     d = str(tmp_path)
-    nh = mk_nodehost("a:1", reg, nodehost_dir=d)
+    nh = mk_nodehost("a:1", reg, nodehost_dir=d, engine_kind=engine_kind)
     try:
         nh.start_cluster({1: "a:1"}, False, KVSM, group_config(3, 1))
         assert wait_for(lambda: nh.get_leader_id(3)[1])
@@ -237,7 +250,7 @@ def test_restart_replay(tmp_path):
         nh.stop()
     # restart: log replay restores the SM
     reg2 = _Registry()
-    nh2 = mk_nodehost("a:1", reg2, nodehost_dir=d)
+    nh2 = mk_nodehost("a:1", reg2, nodehost_dir=d, engine_kind=engine_kind)
     try:
         nh2.start_cluster({1: "a:1"}, False, KVSM, group_config(3, 1))
         assert wait_for(lambda: nh2.get_leader_id(3)[1], timeout=15)
@@ -248,10 +261,10 @@ def test_restart_replay(tmp_path):
         nh2.stop()
 
 
-def test_leader_transfer():
+def test_leader_transfer(engine_kind):
     reg = _Registry()
     members = {1: "a:1", 2: "b:2", 3: "c:3"}
-    nhs = {nid: mk_nodehost(addr, reg) for nid, addr in members.items()}
+    nhs = {nid: mk_nodehost(addr, reg, engine_kind=engine_kind) for nid, addr in members.items()}
     try:
         for nid, nh in nhs.items():
             nh.start_cluster(members, False, KVSM, group_config(11, nid))
