@@ -40,6 +40,36 @@ _MSG = struct.Struct("<BQQQQQQQBQQIB")
 _STATE = struct.Struct("<QQQ")
 
 
+class CodecError(ValueError):
+    """The single controlled failure mode of every decode_* function:
+    corrupt or truncated input raises this (found by dragonboat_tpu.fuzz;
+    the reference gets the same guarantee from protobuf unmarshal errors,
+    raftpb/fuzz.go:15-49)."""
+
+
+def _need(buf, off: int, n: int) -> None:
+    if n < 0 or off + n > len(buf):
+        raise CodecError(f"truncated: need {n} bytes at {off}, have {len(buf)}")
+
+
+def _checked(fn):
+    """Public decoders convert every low-level unpack failure (truncated
+    struct, bad enum value, invalid utf-8) into CodecError."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrap(buf, off: int = 0):
+        try:
+            return fn(buf, off)
+        except CodecError:
+            raise
+        except (struct.error, ValueError, UnicodeDecodeError, IndexError,
+                OverflowError) as e:
+            raise CodecError(f"{fn.__name__}: {e}") from e
+
+    return wrap
+
+
 def _pack_bytes(b: bytes) -> bytes:
     return _U32.pack(len(b)) + b
 
@@ -47,6 +77,7 @@ def _pack_bytes(b: bytes) -> bytes:
 def _unpack_bytes(buf, off: int) -> Tuple[bytes, int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
+    _need(buf, off, n)
     return bytes(buf[off : off + n]), off + n
 
 
@@ -57,6 +88,17 @@ def _pack_str(s: str) -> bytes:
 def _unpack_str(buf, off: int) -> Tuple[str, int]:
     b, off = _unpack_bytes(buf, off)
     return b.decode(), off
+
+
+def _unpack_count(buf, off: int, min_item_size: int) -> Tuple[int, int]:
+    """Length-prefixed collection count, bounded by the bytes that could
+    possibly remain — a corrupt count must not drive a multi-billion
+    iteration loop."""
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    if min_item_size > 0 and n > (len(buf) - off) // min_item_size:
+        raise CodecError(f"corrupt collection count {n} at {off}")
+    return n, off
 
 
 # ---------------------------------------------------------------- Entry
@@ -77,9 +119,11 @@ def encode_entry(e: Entry) -> bytes:
     )
 
 
+@_checked
 def decode_entry(buf, off: int = 0) -> Tuple[Entry, int]:
     t, term, index, key, cid, sid, resp, clen = _ENTRY.unpack_from(buf, off)
     off += _ENTRY.size
+    _need(buf, off, clen)
     cmd = bytes(buf[off : off + clen])
     return (
         Entry(
@@ -102,9 +146,9 @@ def encode_entries(entries: List[Entry]) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_entries(buf, off: int = 0) -> Tuple[List[Entry], int]:
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
+    n, off = _unpack_count(buf, off, _ENTRY.size)
     out = []
     for _ in range(n):
         e, off = decode_entry(buf, off)
@@ -118,6 +162,7 @@ def encode_state(st: State) -> bytes:
     return _STATE.pack(st.term, st.vote, st.commit)
 
 
+@_checked
 def decode_state(buf, off: int = 0) -> Tuple[State, int]:
     term, vote, commit = _STATE.unpack_from(buf, off)
     return State(term=term, vote=vote, commit=commit), off + _STATE.size
@@ -134,8 +179,7 @@ def _pack_addr_map(m: dict) -> bytes:
 
 
 def _unpack_addr_map(buf, off: int) -> Tuple[dict, int]:
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
+    n, off = _unpack_count(buf, off, 12)  # u64 nid + u32 len prefix
     out = {}
     for _ in range(n):
         (nid,) = _U64.unpack_from(buf, off)
@@ -157,14 +201,14 @@ def encode_membership(m: Membership) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_membership(buf, off: int = 0) -> Tuple[Membership, int]:
     (ccid,) = _U64.unpack_from(buf, off)
     off += 8
     addresses, off = _unpack_addr_map(buf, off)
     observers, off = _unpack_addr_map(buf, off)
     witnesses, off = _unpack_addr_map(buf, off)
-    (n,) = _U32.unpack_from(buf, off)
-    off += 4
+    n, off = _unpack_count(buf, off, 8)
     removed = {}
     for _ in range(n):
         (nid,) = _U64.unpack_from(buf, off)
@@ -217,6 +261,7 @@ def encode_snapshot(ss: Snapshot) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_snapshot(buf, off: int = 0) -> Tuple[Snapshot, int]:
     fs, idx, term, cid, dummy, typ, imported, witness, odi = _SS.unpack_from(buf, off)
     off += _SS.size
@@ -227,8 +272,7 @@ def decode_snapshot(buf, off: int = 0) -> Tuple[Snapshot, int]:
     membership = None
     if has_m:
         membership, off = decode_membership(buf, off)
-    (nf,) = _U32.unpack_from(buf, off)
-    off += 4
+    nf, off = _unpack_count(buf, off, 24)  # 2x u64 + 2x u32 prefixes
     files = []
     for _ in range(nf):
         (fid,) = _U64.unpack_from(buf, off)
@@ -286,6 +330,7 @@ def encode_message(m: Message) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_message(buf, off: int = 0) -> Tuple[Message, int]:
     (
         t,
@@ -343,6 +388,7 @@ def encode_message_batch(b: MessageBatch) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_message_batch(buf, off: int = 0) -> Tuple[MessageBatch, int]:
     (did,) = _U64.unpack_from(buf, off)
     off += 8
@@ -401,6 +447,7 @@ def encode_chunk(c: SnapshotChunk) -> bytes:
     return b"".join(parts)
 
 
+@_checked
 def decode_chunk(buf, off: int = 0) -> Tuple[SnapshotChunk, int]:
     (
         cid,
@@ -469,6 +516,7 @@ def encode_bootstrap(b: Bootstrap) -> bytes:
     )
 
 
+@_checked
 def decode_bootstrap(buf, off: int = 0) -> Tuple[Bootstrap, int]:
     addresses, off = _unpack_addr_map(buf, off)
     join = buf[off] == 1
