@@ -1,0 +1,789 @@
+"""Ported etcd/raft paper-conformance scenarios against the scalar core
+(round-3 expansion; companion to test_raft_etcd_conformance.py).
+
+The reference vendors etcd's raft tests for corner-case parity
+(internal/raft/raft_etcd_paper_test.go — each test names the Raft paper
+section it validates — plus raft_etcd_test.go matrices; docs/test.md:4).
+These re-express those matrices against our scalar core through the same
+message-level interface. Citations name the etcd test and paper section.
+"""
+import pytest
+
+from dragonboat_tpu.core.logentry import InMemLogDB
+from dragonboat_tpu.core.raft import Raft, RaftNodeState
+from dragonboat_tpu.types import (
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    State,
+    SystemCtx,
+)
+
+from tests.raft_harness import Network, make_cluster, new_test_raft
+
+MT = MessageType
+F, C, L = RaftNodeState.FOLLOWER, RaftNodeState.CANDIDATE, RaftNodeState.LEADER
+OBS, WIT = RaftNodeState.OBSERVER, RaftNodeState.WITNESS
+
+
+def logdb_with_terms(*terms: int) -> InMemLogDB:
+    db = InMemLogDB()
+    db.append([Entry(index=i + 1, term=t) for i, t in enumerate(terms)])
+    return db
+
+
+def terms_of(r: Raft):
+    first, last = r.log.first_index(), r.log.last_index()
+    return [r.log.term(i) for i in range(first, last + 1)]
+
+
+def tick_until_election(r: Raft) -> None:
+    for _ in range(2 * r.election_timeout):
+        r.tick()
+
+
+def make_leader(r: Raft) -> None:
+    tick_until_election(r)
+    for nid in list(r.remotes):
+        if nid != r.node_id:
+            r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=nid, to=r.node_id,
+                             term=r.term, reject=False))
+            if r.is_leader():
+                break
+    assert r.is_leader()
+
+
+# ---------------------------------------------------------------------------
+# etcd TestUpdateTermFromMessage (paper §5.1): any state adopts a higher term
+# and becomes follower.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("start", ["follower", "candidate", "leader"])
+def test_update_term_from_message(start):
+    r = new_test_raft(1, [1, 2, 3])
+    if start == "candidate":
+        tick_until_election(r)
+    elif start == "leader":
+        make_leader(r)
+    r.handle(Message(type=MT.REPLICATE, from_=2, to=1, term=10))
+    assert r.term == 10
+    assert r.state == F
+
+
+# ---------------------------------------------------------------------------
+# etcd TestStartAsFollower (paper §5.2)
+# ---------------------------------------------------------------------------
+def test_start_as_follower():
+    r = new_test_raft(1, [1, 2, 3])
+    assert r.state == F and r.term == 0
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderBcastBeat (paper §5.2): heartbeat_timeout ticks -> beats to
+# every voting peer, carrying no entries.
+# ---------------------------------------------------------------------------
+def test_leader_bcast_beat_carries_no_entries():
+    r = new_test_raft(1, [1, 2, 3], election=10, heartbeat=1)
+    make_leader(r)
+    r.msgs = []
+    r.tick()
+    beats = [m for m in r.msgs if m.type == MT.HEARTBEAT]
+    assert {m.to for m in beats} == {2, 3}
+    assert all(not m.entries for m in beats)
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderElectionInOneRoundRPC (paper §5.2): vote outcomes decide
+# the election in one round.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "size,votes,want_state",
+    [
+        (1, {}, L),
+        (3, {2: True, 3: True}, L),
+        (3, {2: True}, L),
+        (5, {2: True, 3: True, 4: True, 5: True}, L),
+        (5, {2: True, 3: True}, L),
+        (3, {2: False, 3: False}, F),
+        (5, {2: False, 3: False, 4: False, 5: False}, F),
+        (3, {}, C),
+        (5, {2: True}, C),
+        (5, {2: False, 3: False}, C),
+    ],
+)
+def test_leader_election_in_one_round(size, votes, want_state):
+    r = new_test_raft(1, list(range(1, size + 1)))
+    tick_until_election(r)
+    for nid, grant in votes.items():
+        r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=nid, to=1,
+                         term=r.term, reject=not grant))
+    assert r.state == want_state
+
+
+# ---------------------------------------------------------------------------
+# etcd TestFollowerVote (paper §5.2): an existing vote binds the follower.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "prior_vote,candidate,want_reject",
+    [
+        (0, 1, False),
+        (0, 2, False),
+        (1, 1, False),  # repeat grant to the same candidate
+        (2, 2, False),
+        (1, 2, True),   # already voted for someone else this term
+        (2, 1, True),
+    ],
+)
+def test_follower_vote_binding(prior_vote, candidate, want_reject):
+    r = new_test_raft(3, [1, 2, 3])
+    r.term = 1
+    r.vote = prior_vote
+    r.handle(Message(type=MT.REQUEST_VOTE, from_=candidate, to=3, term=1,
+                     log_index=0, log_term=0))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP][-1]
+    assert resp.reject == want_reject
+
+
+# ---------------------------------------------------------------------------
+# etcd TestCandidateFallback (paper §5.2): Replicate at >= candidate's term
+# demotes the candidate.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dterm", [0, 1])
+def test_candidate_fallback(dterm):
+    r = new_test_raft(1, [1, 2, 3])
+    tick_until_election(r)
+    assert r.state == C and r.term == 1
+    r.handle(Message(type=MT.REPLICATE, from_=2, to=1, term=r.term + dterm))
+    assert r.state == F
+    assert r.leader_id == 2
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderStartReplication (paper §5.3): propose appends locally and
+# broadcasts Replicate with the correct prev position.
+# ---------------------------------------------------------------------------
+def test_leader_start_replication():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    prev = r.log.last_index()
+    prev_term = r.log.last_term()
+    # ack the new-leader noop so both remotes leave the probe (WAIT) state —
+    # a paused remote receives no optimistic Replicates (remote.go:173-186)
+    for nid in (2, 3):
+        r.handle(Message(type=MT.REPLICATE_RESP, from_=nid, to=1, term=r.term,
+                         log_index=prev))
+    r.msgs = []
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1,
+                     entries=[Entry(cmd=b"some data")]))
+    assert r.log.last_index() == prev + 1
+    reps = [m for m in r.msgs if m.type == MT.REPLICATE]
+    assert {m.to for m in reps} == {2, 3}
+    for m in reps:
+        assert m.log_index == prev
+        assert m.log_term == prev_term
+        assert [e.cmd for e in m.entries] == [b"some data"]
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderCommitEntry / TestLeaderAcknowledgeCommit (paper §5.3):
+# the entry commits once a quorum acks it.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "size,ackers,want_commit",
+    [
+        (1, set(), True),
+        (3, set(), False),
+        (3, {2}, True),
+        (3, {2, 3}, True),
+        (5, set(), False),
+        (5, {2}, False),
+        (5, {2, 3}, True),
+        (5, {2, 3, 4}, True),
+    ],
+)
+def test_leader_acknowledge_commit(size, ackers, want_commit):
+    r = new_test_raft(1, list(range(1, size + 1)))
+    make_leader(r)
+    base = r.log.committed
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    li = r.log.last_index()
+    for nid in ackers:
+        r.handle(Message(type=MT.REPLICATE_RESP, from_=nid, to=1, term=r.term,
+                         log_index=li))
+    assert (r.log.committed > base and r.log.committed == li) == want_commit
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderCommitPrecedingEntries (paper §5.3): committing a new entry
+# commits everything before it.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("prior", [(), (2,), (1,), (1, 1)], ids=["0", "t2", "t1", "t1t1"])
+def test_leader_commit_preceding_entries(prior):
+    db = logdb_with_terms(*prior)
+    db.set_state(State(term=2))
+    r = new_test_raft(1, [1, 2, 3], logdb=db)
+    r.term = 2
+    tick_until_election(r)
+    r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=2, to=1, term=r.term,
+                     reject=False))
+    assert r.is_leader()
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    li = r.log.last_index()
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=li))
+    assert r.log.committed == li  # everything through li is committed
+
+
+# ---------------------------------------------------------------------------
+# etcd TestFollowerCommitEntry (paper §5.3): follower commits min(leader
+# commit, last new entry).
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "n_ents,commit",
+    [(1, 1), (2, 2), (2, 1), (3, 2)],
+)
+def test_follower_commit_entry(n_ents, commit):
+    r = new_test_raft(2, [1, 2, 3])
+    ents = [Entry(index=i + 1, term=1, cmd=b"e%d" % i) for i in range(n_ents)]
+    r.handle(Message(type=MT.REPLICATE, from_=1, to=2, term=1, log_index=0,
+                     log_term=0, commit=commit, entries=ents))
+    assert r.log.committed == commit
+    assert r.log.last_index() == n_ents
+
+
+# ---------------------------------------------------------------------------
+# etcd TestFollowerCheckMsgApp (paper §5.3): the log-matching check on
+# (prev_index, prev_term).
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "prev_term,prev_index,want_reject,want_hint",
+    [
+        (0, 0, False, 0),   # empty prev always matches
+        (1, 1, False, 0),   # matches an existing entry
+        (2, 2, False, 0),
+        (1, 2, True, 2),    # term mismatch at index 2
+        (2, 3, True, 2),    # beyond the log; hint = follower last index
+        (3, 3, True, 2),
+    ],
+)
+def test_follower_check_replicate(prev_term, prev_index, want_reject, want_hint):
+    db = logdb_with_terms(1, 2)
+    db.set_state(State(term=2, commit=1))
+    r = new_test_raft(2, [1, 2, 3], logdb=db)
+    r.term = 2
+    r.handle(Message(type=MT.REPLICATE, from_=1, to=2, term=2,
+                     log_index=prev_index, log_term=prev_term))
+    resp = [m for m in r.msgs if m.type == MT.REPLICATE_RESP][-1]
+    assert resp.reject == want_reject
+    if want_reject:
+        assert resp.hint == want_hint
+
+
+# ---------------------------------------------------------------------------
+# etcd TestFollowerAppendEntries (paper §5.3): conflicting suffixes are
+# truncated and rewritten.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "prev_index,prev_term,ents,want",
+    [
+        (2, 2, [(3, 3)], [1, 2, 3]),
+        (1, 1, [(2, 3), (3, 4)], [1, 3, 4]),
+        (0, 0, [(1, 1)], [1, 2]),          # duplicate of existing prefix
+        (0, 0, [(1, 3)], [3]),             # full rewrite from index 1
+    ],
+)
+def test_follower_append_entries(prev_index, prev_term, ents, want):
+    db = logdb_with_terms(1, 2)
+    db.set_state(State(term=2))
+    r = new_test_raft(2, [1, 2, 3], logdb=db)
+    r.term = 2
+    r.handle(Message(
+        type=MT.REPLICATE, from_=1, to=2, term=2,
+        log_index=prev_index, log_term=prev_term,
+        entries=[Entry(index=i, term=t) for i, t in ents],
+    ))
+    assert terms_of(r) == want
+
+
+# ---------------------------------------------------------------------------
+# etcd TestHandleHeartbeat: heartbeat commit is bounded by the follower's
+# last index; it never regresses commit.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("hb_commit,want", [(3, 3), (1, 2), (2, 2)])
+def test_handle_heartbeat_commit_bounds(hb_commit, want):
+    # a heartbeat commit NEVER exceeds the follower's log: the sender caps
+    # it at min(match, committed) (raft.go:810-816); etcd's commitTo panics
+    # if that invariant is violated, so only in-range values are tested
+    db = logdb_with_terms(1, 2, 3)
+    db.set_state(State(term=3, commit=2))
+    r = new_test_raft(2, [1, 2], logdb=db)
+    r.term = 3
+    r.log.commit_to(2)
+    r.handle(Message(type=MT.HEARTBEAT, from_=1, to=2, term=3,
+                     commit=hb_commit))
+    assert r.log.committed == want
+    resp = [m for m in r.msgs if m.type == MT.HEARTBEAT_RESP]
+    assert resp, "heartbeat must be acked"
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderAppResp: accepted/rejected ReplicateResp moves match/next.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "reject,resp_index,hint,want_match,want_next",
+    [
+        (False, 2, 0, 2, 3),    # ack moves match and next
+        (False, 0, 0, 0, 1),    # stale ack: no movement below current
+        (True, 3, 0, 0, 1),     # probe reject at next-1 backs off
+    ],
+)
+def test_leader_replicate_resp_progress(reject, resp_index, hint,
+                                        want_match, want_next):
+    db = logdb_with_terms(1, 1)
+    db.set_state(State(term=1))
+    r = new_test_raft(1, [1, 2, 3], logdb=db)
+    r.term = 1
+    r.state = C
+    r.become_leader()
+    rp = r.remotes[2]
+    rp.match, rp.next = 0, r.log.last_index() + 1
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=resp_index, reject=reject, hint=hint))
+    assert r.remotes[2].match == want_match
+    assert r.remotes[2].next >= want_next
+
+
+# ---------------------------------------------------------------------------
+# etcd TestRecvMsgBeat equivalent: only a leader emits heartbeats on its
+# heartbeat timer; followers' ticks emit nothing.
+# ---------------------------------------------------------------------------
+def test_follower_tick_emits_no_heartbeats():
+    r = new_test_raft(1, [1, 2, 3], election=50)
+    for _ in range(5):
+        r.tick()
+    assert [m for m in r.msgs if m.type == MT.HEARTBEAT] == []
+
+
+# ---------------------------------------------------------------------------
+# etcd TestStepIgnoreConfig: a second config-change proposal while one is
+# pending is replaced by an empty application entry.
+# ---------------------------------------------------------------------------
+def test_second_config_change_stripped():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    cc = Entry(type=EntryType.CONFIG_CHANGE, cmd=b"cc1")
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[cc]))
+    assert r.pending_config_change
+    i1 = r.log.last_index()
+    cc2 = Entry(type=EntryType.CONFIG_CHANGE, cmd=b"cc2")
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[cc2]))
+    ents = r.log.entries(i1 + 1, 1 << 20)
+    assert len(ents) == 1
+    assert ents[0].type == EntryType.APPLICATION  # stripped to a noop
+    assert r.pending_config_change  # still just the first one pending
+
+
+# ---------------------------------------------------------------------------
+# etcd TestNewLeaderPendingConfig: an uncommitted config-change entry in the
+# log re-arms the pending flag on promotion.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("has_uncommitted_cc", [False, True])
+def test_new_leader_rearms_pending_config(has_uncommitted_cc):
+    db = InMemLogDB()
+    if has_uncommitted_cc:
+        db.append([Entry(index=1, term=1, type=EntryType.CONFIG_CHANGE)])
+    r = new_test_raft(1, [1, 2, 3], logdb=db)
+    r.term = 1
+    tick_until_election(r)
+    r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=2, to=1, term=r.term,
+                     reject=False))
+    assert r.is_leader()
+    assert r.pending_config_change == has_uncommitted_cc
+
+
+# ---------------------------------------------------------------------------
+# etcd TestAddNode / TestRemoveNode / TestAddObserver semantics.
+# ---------------------------------------------------------------------------
+def test_add_node_creates_remote():
+    r = new_test_raft(1, [1])
+    make_leader(r)
+    r.add_node(2)
+    assert set(r.remotes) == {1, 2}
+    assert r.remotes[2].next == r.log.last_index() + 1
+    assert not r.pending_config_change
+
+
+def test_add_node_promotes_observer_with_progress():
+    r = new_test_raft(1, [1])
+    make_leader(r)
+    r.add_observer(2)
+    r.observers[2].match = 5
+    r.add_node(2)
+    assert 2 in r.remotes and 2 not in r.observers
+    assert r.remotes[2].match == 5  # progress carried over
+
+
+def test_remove_node_drops_remote_and_recommits():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    li = r.log.last_index()
+    # only replica 2 acked; quorum of 3 not reached
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=li))
+    committed_before = r.log.committed
+    # removing node 3 shrinks the quorum to 2/2 -> the entry commits now
+    r.remove_node(3)
+    assert 3 not in r.remotes
+    assert r.log.committed == li >= committed_before
+
+
+def test_remove_self_leader_steps_down():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.remove_node(1)
+    assert not r.is_leader()
+
+
+# ---------------------------------------------------------------------------
+# etcd TestLeaderTransfer matrices (thesis §3.10).
+# ---------------------------------------------------------------------------
+def test_transfer_to_up_to_date_follower_sends_timeout_now():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.remotes[2].match = r.log.last_index()
+    r.msgs = []
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=2, to=1, hint=2))
+    assert [m.to for m in r.msgs if m.type == MT.TIMEOUT_NOW] == [2]
+
+
+def test_transfer_to_lagging_follower_waits_for_catchup():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    r.msgs = []
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=2, to=1, hint=2))
+    assert [m for m in r.msgs if m.type == MT.TIMEOUT_NOW] == []
+    # proposals are dropped during a transfer (raft thesis §3.10)
+    li = r.log.last_index()
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"y")]))
+    assert r.log.last_index() == li
+    # the target catching up triggers the TimeoutNow
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=li))
+    assert [m.to for m in r.msgs if m.type == MT.TIMEOUT_NOW] == [2]
+
+
+def test_transfer_to_self_is_noop():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.msgs = []
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=1, to=1, hint=1))
+    assert not r.leader_transfering()
+
+
+def test_second_transfer_ignored_while_transferring():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=2, to=1, hint=2))
+    assert r.leader_transfer_target == 2
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=3, to=1, hint=3))
+    assert r.leader_transfer_target == 2
+
+
+def test_transfer_aborts_after_election_timeout():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    r.handle(Message(type=MT.LEADER_TRANSFER, from_=2, to=1, hint=2))
+    assert r.leader_transfering()
+    for _ in range(r.election_timeout + 1):
+        r.tick()
+    assert not r.leader_transfering()
+    assert r.is_leader()  # still leader; transfer just timed out
+
+
+def test_timeout_now_triggers_immediate_campaign():
+    """etcd TestLeaderTransferReceiveHigherTermVote leg: TimeoutNow makes the
+    target campaign regardless of its election timer."""
+    r = new_test_raft(2, [1, 2, 3])
+    r.term = 1
+    r.handle(Message(type=MT.TIMEOUT_NOW, from_=1, to=2, term=1))
+    assert r.state == C
+    assert r.term == 2
+    # the vote requests carry the transfer hint so the disruption defense
+    # does not drop them (raft.go:1387-1409)
+    reqs = [m for m in r.msgs if m.type == MT.REQUEST_VOTE]
+    assert reqs and all(m.hint == 2 for m in reqs)
+
+
+# ---------------------------------------------------------------------------
+# Check-quorum (etcd TestLeaderStepdownWhenQuorumLost/Active, §6.2).
+# ---------------------------------------------------------------------------
+def test_leader_steps_down_when_quorum_lost():
+    r = new_test_raft(1, [1, 2, 3], check_quorum=True)
+    make_leader(r)
+    for _ in range(r.election_timeout + 1):
+        r.tick()
+    assert r.state == F
+
+
+def test_leader_stays_when_quorum_active():
+    r = new_test_raft(1, [1, 2, 3], check_quorum=True)
+    make_leader(r)
+    for i in range(r.election_timeout + 1):
+        r.handle(Message(type=MT.HEARTBEAT_RESP, from_=2, to=1, term=r.term))
+        r.tick()
+    assert r.state == L
+
+
+def test_free_stuck_candidate_with_check_quorum():
+    """etcd TestFreeStuckCandidateWithCheckQuorum: a NOOP response frees a
+    candidate stuck at a higher term behind a partition."""
+    nt = make_cluster(3)
+    for r in nt.rafts.values():
+        r.check_quorum = True
+    nt.elect(1)
+    nt.isolate(3)
+    nt.elect(3)  # partitioned: term rises, no votes arrive
+    nt.elect(3)
+    r3 = nt.rafts[3]
+    assert r3.state == C and r3.term > nt.rafts[1].term
+    nt.heal()
+    # leader contact at lower term makes 3 send a NOOP carrying its term,
+    # which forces a re-election at 3's term instead of wedging
+    nt.send(Message(type=MT.HEARTBEAT, from_=1, to=3,
+                    term=nt.rafts[1].term))
+    assert nt.rafts[1].term >= r3.term
+
+
+# ---------------------------------------------------------------------------
+# Disruption defense (reference raft.go:1387-1409): a fresh leader lease
+# drops non-transfer RequestVotes from higher terms.
+# ---------------------------------------------------------------------------
+def test_fresh_leader_lease_drops_higher_term_vote():
+    r = new_test_raft(1, [1, 2, 3], check_quorum=True)
+    r.term = 1
+    r.leader_id = 3
+    r.election_tick = 0
+    r.handle(Message(type=MT.REQUEST_VOTE, from_=2, to=1, term=5,
+                     log_index=10, log_term=5))
+    assert r.term == 1  # dropped: term not adopted
+    r.handle(Message(type=MT.REQUEST_VOTE, from_=2, to=1, term=5,
+                     log_index=10, log_term=5, hint=2))  # transfer-hinted
+    assert r.term == 5  # transfer votes bypass the lease
+
+
+# ---------------------------------------------------------------------------
+# Observers (etcd learner semantics).
+# ---------------------------------------------------------------------------
+def test_observer_never_campaigns():
+    r = new_test_raft(1, [1, 2], is_observer=True)
+    db_state = r.state
+    assert db_state == OBS
+    for _ in range(5 * r.election_timeout):
+        r.tick()
+    assert r.state == OBS
+    assert [m for m in r.msgs if m.type == MT.REQUEST_VOTE] == []
+
+
+def test_observer_receives_entries_but_has_no_vote():
+    r = new_test_raft(2, [1], is_observer=True)
+    r.handle(Message(type=MT.REPLICATE, from_=1, to=2, term=1, log_index=0,
+                     log_term=0, commit=1, entries=[Entry(index=1, term=1)]))
+    assert r.log.last_index() == 1
+    assert r.log.committed == 1
+
+
+def test_witness_votes_but_never_campaigns():
+    r = new_test_raft(3, [1, 2], is_witness=True)
+    assert r.state == WIT
+    for _ in range(5 * r.election_timeout):
+        r.tick()
+    assert r.state == WIT
+    r.handle(Message(type=MT.REQUEST_VOTE, from_=1, to=3, term=2,
+                     log_index=5, log_term=2))
+    resp = [m for m in r.msgs if m.type == MT.REQUEST_VOTE_RESP][-1]
+    assert resp.reject is False
+
+
+# ---------------------------------------------------------------------------
+# ReadIndex (thesis §6.4).
+# ---------------------------------------------------------------------------
+def test_read_index_requires_current_term_commit():
+    db = logdb_with_terms(1)  # committed entry from an OLD term only
+    db.set_state(State(term=1, commit=1))
+    r = new_test_raft(1, [1, 2, 3], logdb=db)
+    r.term = 1
+    r.log.commit_to(1)
+    tick_until_election(r)
+    r.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=2, to=1, term=r.term,
+                     reject=False))
+    assert r.is_leader()
+    r.msgs = []
+    r.ready_to_read = []
+    # no entry committed at the NEW term yet: the read must be dropped
+    r.handle(Message(type=MT.READ_INDEX, from_=1, to=1, hint=7, hint_high=1))
+    assert r.ready_to_read == []
+    # commit the new-term noop, then the read goes through with hints
+    li = r.log.last_index()
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=li))
+    assert r.log.committed == li
+    r.msgs = []
+    r.handle(Message(type=MT.READ_INDEX, from_=1, to=1, hint=9, hint_high=1))
+    beats = [m for m in r.msgs if m.type == MT.HEARTBEAT]
+    assert beats and all(m.hint == 9 for m in beats)
+
+
+def test_read_index_single_node_immediate():
+    r = new_test_raft(1, [1])
+    make_leader(r)
+    r.handle(Message(type=MT.READ_INDEX, from_=1, to=1, hint=5, hint_high=1))
+    assert r.ready_to_read
+    assert r.ready_to_read[-1].system_ctx.low == 5
+
+
+def test_read_index_quorum_confirmation_releases():
+    r = new_test_raft(1, [1, 2, 3])
+    make_leader(r)
+    li = r.log.last_index()
+    r.handle(Message(type=MT.REPLICATE_RESP, from_=2, to=1, term=r.term,
+                     log_index=li))
+    assert r.log.committed == li
+    r.handle(Message(type=MT.READ_INDEX, from_=1, to=1, hint=11, hint_high=1))
+    assert not r.ready_to_read
+    # one follower echoing the ctx in a HeartbeatResp completes the quorum
+    r.handle(Message(type=MT.HEARTBEAT_RESP, from_=2, to=1, term=r.term,
+                     hint=11, hint_high=1))
+    assert r.ready_to_read
+    assert r.ready_to_read[-1].index == li
+
+
+def test_follower_forwards_read_index_to_leader():
+    r = new_test_raft(2, [1, 2, 3])
+    r.term = 1
+    r.handle(Message(type=MT.HEARTBEAT, from_=1, to=2, term=1))
+    assert r.leader_id == 1
+    r.msgs = []
+    r.handle(Message(type=MT.READ_INDEX, from_=2, to=2, hint=3, hint_high=1))
+    fwd = [m for m in r.msgs if m.type == MT.READ_INDEX]
+    assert fwd and fwd[-1].to == 1
+
+
+# ---------------------------------------------------------------------------
+# etcd TestRestoreIgnoreSnapshot: a snapshot at or below the commit index is
+# rejected (fast-acked instead).
+# ---------------------------------------------------------------------------
+def test_restore_ignores_stale_snapshot():
+    db = logdb_with_terms(1, 1, 1)
+    db.set_state(State(term=1, commit=3))
+    r = new_test_raft(1, [1, 2], logdb=db)
+    r.term = 1
+    r.log.commit_to(3)
+    ss = Snapshot(index=2, term=1,
+                  membership=Membership(addresses={1: "a", 2: "b"}))
+    assert r.restore(ss) is False
+    assert r.log.committed == 3
+
+
+# ---------------------------------------------------------------------------
+# etcd TestSlowNodeRestore path: after restore the follower acks at the
+# snapshot index so the leader can resume replication from there.
+# ---------------------------------------------------------------------------
+def test_follower_acks_snapshot_index_after_restore():
+    r = new_test_raft(2, [1, 2])
+    ss = Snapshot(index=7, term=3,
+                  membership=Membership(addresses={1: "a", 2: "b"}))
+    r.handle(Message(type=MT.INSTALL_SNAPSHOT, from_=1, to=2, term=3,
+                     snapshot=ss))
+    resp = [m for m in r.msgs if m.type == MT.REPLICATE_RESP][-1]
+    assert resp.log_index == 7
+    assert not resp.reject
+
+
+# ---------------------------------------------------------------------------
+# Unreachable / flow control (etcd TestMsgUnreachable).
+# ---------------------------------------------------------------------------
+def test_unreachable_resets_replicate_to_retry():
+    from dragonboat_tpu.core.remote import RemoteState
+
+    r = new_test_raft(1, [1, 2])
+    make_leader(r)
+    r.handle(Message(type=MT.PROPOSE, from_=1, to=1, entries=[Entry(cmd=b"x")]))
+    rp = r.remotes[2]
+    rp.become_replicate()
+    r.handle(Message(type=MT.UNREACHABLE, from_=2, to=1))
+    assert rp.state == RemoteState.RETRY
+
+
+def test_snapshot_status_failure_enters_wait():
+    from dragonboat_tpu.core.remote import RemoteState
+
+    r = new_test_raft(1, [1, 2])
+    make_leader(r)
+    rp = r.remotes[2]
+    rp.become_snapshot(9)
+    r.handle(Message(type=MT.SNAPSHOT_STATUS, from_=2, to=1, reject=True))
+    assert rp.state == RemoteState.WAIT
+    assert rp.snapshot_index == 0  # cleared for retry
+
+
+# ---------------------------------------------------------------------------
+# Full-network integration matrices (etcd TestLeaderElection /
+# TestLogReplication shapes).
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("n", [1, 3, 5])
+def test_cluster_elects_exactly_one_leader(n):
+    nt = make_cluster(n)
+    nt.elect(1)
+    leaders = [r for r in nt.rafts.values() if r.is_leader()]
+    assert len(leaders) == 1 and leaders[0].node_id == 1
+
+
+@pytest.mark.parametrize("proposer", [1, 2, 3])
+def test_log_replication_from_any_node(proposer):
+    nt = make_cluster(3)
+    nt.elect(1)
+    nt.propose(proposer, b"data")
+    committed = {r.log.committed for r in nt.rafts.values()}
+    assert len(committed) == 1
+    for r in nt.rafts.values():
+        ents = r.log.entries(1, 1 << 20)
+        assert any(e.cmd == b"data" for e in ents)
+
+
+def test_minority_partition_cannot_commit():
+    nt = make_cluster(5)
+    nt.elect(1)
+    for nid in (4, 5):
+        nt.isolate(nid)
+    before = nt.rafts[1].log.committed
+    nt.propose(1, b"maj")
+    assert nt.rafts[1].log.committed == before + 1  # 3/5 still commits
+    # now isolate down to a minority: no further commits
+    nt.isolate(3)
+    nt.isolate(2)
+    before = nt.rafts[1].log.committed
+    nt.propose(1, b"min")
+    assert nt.rafts[1].log.committed == before
+
+
+def test_partitioned_leader_rejoins_and_converges():
+    nt = make_cluster(3)
+    nt.elect(1)
+    nt.isolate(1)
+    nt.elect(2)  # majority side elects at a higher term
+    assert nt.rafts[2].is_leader()
+    nt.propose(2, b"while-partitioned")
+    nt.heal()
+    # old leader rejoins; new leader's heartbeat demotes it
+    nt.send(Message(type=MT.HEARTBEAT, from_=2, to=1,
+                    term=nt.rafts[2].term))
+    nt.propose(2, b"after-heal")
+    assert not nt.rafts[1].is_leader()
+    assert terms_of(nt.rafts[1]) == terms_of(nt.rafts[2])
