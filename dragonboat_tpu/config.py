@@ -72,15 +72,22 @@ class EngineConfig:
     """
 
     # "vector" = the device-kernel engine (engine/vector.py) advancing all
-    # groups in one compiled step; "scalar" = per-group Python Peer stepping
-    # (engine/execengine.py).
-    kind: str = "scalar"
+    # groups in one compiled step — the TPU-native flagship and the
+    # default; "scalar" = per-group Python Peer stepping
+    # (engine/execengine.py), kept as the portable fallback/oracle.
+    kind: str = "vector"
+    # Shard the engine's (G, ...) state over every visible jax device
+    # (jax.sharding.Mesh along the group axis). Groups are independent
+    # Raft instances, so the kernel partitions with no cross-device
+    # collectives on the hot path.
+    shard_over_mesh: bool = False
     # Max Raft groups per NodeHost; the G dimension of the kernel tensors.
-    max_groups: int = 1024
+    # (Default sized for fast bring-up; large fleets raise it explicitly.)
+    max_groups: int = 128
     # Max peers per group (incl. self); the P dimension.
     max_peers: int = 8
     # Device-resident log window per group (entries of (term) metadata).
-    log_window: int = 512
+    log_window: int = 256
     # Max inbound protocol messages consumed per group per kernel step.
     inbox_depth: int = 8
     # Max outstanding ReadIndex system contexts per group on device.

@@ -77,7 +77,7 @@ class Node:
         self._send_message = send_message
         self.engine = engine
         self.events = event_listener
-        self.clock = LogicalClock()
+        self.clock = self._make_clock(engine)
         self.pending_proposals = PendingProposal(self.clock)
         self.pending_read_indexes = PendingReadIndex(self.clock)
         self.pending_config_change = PendingConfigChange(self.clock)
@@ -109,6 +109,9 @@ class Node:
         self._snapshot_lock = threading.Lock()
         self._snapshot_in_progress = False
         self._stream_requests: List = []
+        from collections import deque
+
+        self._snapshot_tasks: deque = deque()
         # launch the protocol core (VectorNode overrides: its protocol state
         # lives in the shared device tensors, not a per-group Peer)
         self.peer = self._launch_core(
@@ -116,6 +119,11 @@ class Node:
         )
         if not self._has_snapshot_to_recover():
             self.initialized.set()
+
+    def _make_clock(self, engine):
+        """Per-node logical clock; the VectorEngine overrides this with one
+        clock shared by every lane so deadlines stay comparable."""
+        return LogicalClock()
 
     def _launch_core(self, cfg, log_reader, peer_addresses, initial, new_node, rng):
         return Peer.launch(
@@ -363,7 +371,11 @@ class Node:
         task needs a snapshot worker (cf. node.go:795)."""
         st = self.sm.handle(batch, apply)
         if st is not None:
-            self._pending_snapshot_task = st
+            # queued, not a single slot: a save request arriving while a
+            # recover task is pending must not overwrite it (the reference
+            # keeps separate req/completed slots per kind,
+            # snapshotstate.go:64-214)
+            self._snapshot_tasks.append(st)
             self.engine.set_snapshot_ready(self.cluster_id)
             return True
         return False
@@ -447,9 +459,11 @@ class Node:
     def run_snapshot_work(self) -> None:
         """Executed on a snapshot worker: take/recover/stream snapshots
         (cf. execengine.go:227-335 snapshot worker mains)."""
-        task = getattr(self, "_pending_snapshot_task", None)
-        self._pending_snapshot_task = None
-        if task is not None:
+        while True:
+            try:
+                task = self._snapshot_tasks.popleft()
+            except IndexError:
+                break
             if task.snapshot_requested:
                 self._do_save_snapshot(task.ss_request or SSRequest())
             elif task.snapshot_available:

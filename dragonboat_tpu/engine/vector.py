@@ -6,8 +6,8 @@ TPU-first replacement: ALL groups hosted by a NodeHost live as lanes of one
 (G, P) tensor state (ops/state.RaftTensors) and advance together in one
 compiled kernel step (ops/kernel.step_batch). The host side of the engine
 
-  1. packs per-group events (ticks, wire messages, proposals, reads,
-     config changes, transfers) into the device Inbox,
+  1. packs per-group events (wire messages, proposals, reads, config
+     changes, transfers) into the device Inbox,
   2. runs the jitted step,
   3. fans the StepOutput out with the reference's ordering invariants
      (cf. execengine.go:474-560): Replicate messages leave BEFORE the
@@ -15,6 +15,15 @@ compiled kernel step (ops/kernel.step_batch). The host side of the engine
      save_raft_state call for every lane; responses (vote grants,
      ReplicateResp) leave only after persistence; committed entries are
      handed to the RSM task workers after persistence.
+
+The host half is vectorized to match the device half: work is driven by a
+dirty set (only lanes with pending host events are touched in Python),
+ticks are a single engine-global counter folded into one device tick
+array (replacing per-lane LocalTick messages, cf. node.go:1152-1159),
+per-lane protocol mirrors live in whole-G numpy arrays refreshed from one
+`jax.device_get` per step, and lane activation is batched into one
+scatter per state field instead of per-lane device dispatches. Idle lanes
+cost zero host work per step.
 
 Payload bytes never touch the device: the kernel works on (index, term,
 is_cc) metadata while the engine keeps an arena of Entry objects keyed by
@@ -32,9 +41,10 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..config import Config, NodeHostConfig
@@ -54,8 +64,10 @@ from ..ops.state import (
     KernelConfig,
     RaftTensors,
     init_state,
+    lane_seed,
     rebase,
 )
+from ..requests import LogicalClock
 from ..settings import soft
 from ..types import (
     Entry,
@@ -93,6 +105,95 @@ def _ctx_origin(enc: int) -> int:
     return (enc >> 24) - 1
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _make_activate_fn(cfg: KernelConfig, n: int):
+    """Jitted bulk lane activation: scatter n lanes' bring-up values into
+    the device state in ONE compiled call. Batches are padded to a few
+    fixed bucket sizes (powers of 4) so each (cfg, n) compiles once —
+    eagerly dispatched `.at[g].set` chains compile a fresh scatter per
+    batch shape, which at fleet bring-up dominated wall clock."""
+    P, W, R = cfg.peers, cfg.log_window, cfg.readindex_depth
+
+    def apply(s: RaftTensors, gi, v):
+        zi = jnp.zeros((n,), jnp.int32)
+        zb = jnp.zeros((n,), bool)
+        zip_ = jnp.zeros((n, P), jnp.int32)
+        zbp = jnp.zeros((n, P), bool)
+        zir = jnp.zeros((n, R), jnp.int32)
+        return s._replace(
+            active=s.active.at[gi].set(True),
+            self_slot=s.self_slot.at[gi].set(v["self_slot"]),
+            member=s.member.at[gi].set(v["member"]),
+            voting=s.voting.at[gi].set(v["voting"]),
+            observer=s.observer.at[gi].set(v["observer"]),
+            witness=s.witness.at[gi].set(v["witness"]),
+            term=s.term.at[gi].set(v["term"]),
+            vote=s.vote.at[gi].set(v["vote"]),
+            role=s.role.at[gi].set(v["role"]),
+            leader=s.leader.at[gi].set(zi),
+            tick_count=s.tick_count.at[gi].set(zi),
+            election_tick=s.election_tick.at[gi].set(zi),
+            heartbeat_tick=s.heartbeat_tick.at[gi].set(zi),
+            election_timeout=s.election_timeout.at[gi].set(
+                v["election_timeout"]
+            ),
+            heartbeat_timeout=s.heartbeat_timeout.at[gi].set(
+                v["heartbeat_timeout"]
+            ),
+            rand_timeout=s.rand_timeout.at[gi].set(v["rand_timeout"]),
+            check_quorum=s.check_quorum.at[gi].set(v["check_quorum"]),
+            first_index=s.first_index.at[gi].set(v["first_index"]),
+            marker_term=s.marker_term.at[gi].set(v["marker_term"]),
+            last_index=s.last_index.at[gi].set(v["last_index"]),
+            committed=s.committed.at[gi].set(v["committed"]),
+            processed=s.processed.at[gi].set(v["processed"]),
+            applied=s.applied.at[gi].set(v["applied"]),
+            unsaved_from=s.unsaved_from.at[gi].set(v["unsaved_from"]),
+            log_term=s.log_term.at[gi].set(v["log_term"]),
+            log_is_cc=s.log_is_cc.at[gi].set(v["log_is_cc"]),
+            match=s.match.at[gi].set(zip_),
+            next=s.next.at[gi].set(
+                jnp.broadcast_to(v["next"][:, None], (n, P))
+            ),
+            rstate=s.rstate.at[gi].set(
+                jnp.full((n, P), RSTATE.RETRY, jnp.int32)
+            ),
+            ract=s.ract.at[gi].set(zbp),
+            snap_sent=s.snap_sent.at[gi].set(zip_),
+            vresp=s.vresp.at[gi].set(zbp),
+            vgrant=s.vgrant.at[gi].set(zbp),
+            transfer_to=s.transfer_to.at[gi].set(zi),
+            transfer_flag=s.transfer_flag.at[gi].set(zb),
+            pending_cc=s.pending_cc.at[gi].set(zb),
+            quiesce_on=s.quiesce_on.at[gi].set(v["quiesce_on"]),
+            quiesce_threshold=s.quiesce_threshold.at[gi].set(
+                v["quiesce_threshold"]
+            ),
+            quiesced=s.quiesced.at[gi].set(zb),
+            idle_ticks=s.idle_ticks.at[gi].set(zi),
+            ri_ctx=s.ri_ctx.at[gi].set(zir),
+            ri_index=s.ri_index.at[gi].set(zir),
+            ri_acks=s.ri_acks.at[gi].set(zir),
+            ri_count=s.ri_count.at[gi].set(zi),
+        )
+
+    return jax.jit(apply, donate_argnums=(0,))
+
+
+class _SharedClock(LogicalClock):
+    """One logical clock shared by every lane of a VectorEngine. The engine
+    loop controls the gc cadence (it runs the pending-queue gc pass itself,
+    only for lanes with outstanding requests), so the per-clock should_gc
+    throttle is disabled — with dozens of Pending* objects sharing one
+    clock, the first caller would otherwise starve the rest."""
+
+    def should_gc(self) -> bool:  # pragma: no cover - trivial
+        return True
+
+
 class VectorNode(Node):
     """A Node whose protocol core is a lane of the shared device state.
 
@@ -100,44 +201,56 @@ class VectorNode(Node):
     transfer), the RSM manager, the snapshotter drivers and the pending
     notification machinery are all inherited; only the protocol stepping is
     different — there is no Peer, the VectorEngine advances every lane in
-    one kernel call.
-    """
+    one kernel call. Protocol status (leader/term/role/commit) is read from
+    the engine's numpy mirror arrays, refreshed once per kernel step."""
+
+    def _make_clock(self, engine):
+        # all lanes share the engine's logical clock so request deadlines
+        # are comparable across lanes and gc is one pass, not G passes
+        return engine.clock
 
     def _launch_core(self, cfg, log_reader, peer_addresses, initial, new_node, rng):
         self._vec_initial = initial
         self._vec_new_node = new_node
         self._vec_addresses = list(peer_addresses)
-        self._status_mu = threading.Lock()
-        self._vstatus = {
-            "leader_id": 0,
-            "term": 0,
-            "state": ROLE.FOLLOWER,
-            "commit": 0,
-        }
+        self._vec_lane = None  # bound by VectorEngine.add_node
         return None  # no scalar Peer
 
     # ------------------------------------------------------------ status
     def get_leader_id(self) -> int:
-        with self._status_mu:
-            return self._vstatus["leader_id"]
+        lane = self._vec_lane
+        if lane is None or not lane.active:
+            return 0
+        eng = self.engine
+        return lane.rev.get(int(eng._m_leader[lane.g]) - 1, 0)
 
     def local_status(self):
-        with self._status_mu:
-            st = dict(self._vstatus)
-        st.update(
-            cluster_id=self.cluster_id,
-            node_id=self._node_id,
-            applied=self.sm.last_applied_index(),
-        )
-        return st
+        lane = self._vec_lane
+        if lane is None:
+            return {
+                "leader_id": 0,
+                "term": 0,
+                "state": ROLE.FOLLOWER,
+                "commit": 0,
+                "cluster_id": self.cluster_id,
+                "node_id": self._node_id,
+                "applied": self.sm.last_applied_index(),
+            }
+        eng = self.engine
+        g = lane.g
+        return {
+            "leader_id": lane.rev.get(int(eng._m_leader[g]) - 1, 0),
+            "term": int(eng._m_term[g]),
+            "state": int(eng._m_role[g]),
+            "commit": int(eng._m_base[g] + eng._m_commit[g]),
+            "cluster_id": self.cluster_id,
+            "node_id": self._node_id,
+            "applied": self.sm.last_applied_index(),
+        }
 
-    def _set_status(self, leader_id: int, term: int, role: int, commit: int) -> None:
-        with self._status_mu:
-            prev = self._vstatus["leader_id"], self._vstatus["term"]
-            self._vstatus.update(
-                leader_id=leader_id, term=term, state=role, commit=commit
-            )
-        if prev != (leader_id, term) and self.events is not None:
+    def _leader_event(self, leader_id: int, term: int) -> None:
+        """Engine loop: the lane's (leader, term) changed this step."""
+        if self.events is not None:
             self.events.leader_updated(
                 self.cluster_id, self._node_id, leader_id, term
             )
@@ -182,13 +295,14 @@ class VectorNode(Node):
 
 
 class _Lane:
-    """Per-group host bookkeeping owned by the engine loop thread."""
+    """Per-group host bookkeeping owned by the engine loop thread. Protocol
+    mirrors (term/role/leader/commit/last/first/base) live in the engine's
+    whole-G numpy arrays, not here."""
 
     __slots__ = (
         "g",
         "node",
         "cfg",
-        "base",
         "slots",
         "rev",
         "arena",
@@ -199,24 +313,18 @@ class _Lane:
         "pack_info",
         "ri_pending",
         "recovering",
+        "adopted_term",
         "catchup",
-        "leader_slot",
-        "term",
-        "role",
-        "committed",
-        "last_index",
-        "first_index",
-        "applied_since_snapshot",
-        "snapshot_pending",
+        "snap_inflight",
         "active",
         "cc_inflight",
+        "mem_sig",
     )
 
     def __init__(self, g: int, node: VectorNode) -> None:
         self.g = g
         self.node = node
         self.cfg: Config = node.config
-        self.base = 0  # real index = device index + base
         self.slots: Dict[int, int] = {}  # node_id -> slot
         self.rev: Dict[int, int] = {}  # slot -> node_id
         self.arena: Dict[int, Entry] = {}  # real index -> Entry
@@ -227,17 +335,26 @@ class _Lane:
         self.pack_info: Dict[int, tuple] = {}
         self.ri_pending: Dict[int, SystemCtx] = {}  # enc -> real ctx
         self.recovering = False
-        self.catchup: Dict[int, Tuple[int, int]] = {}  # slot -> (next, goal)
-        self.leader_slot = -1
-        self.term = 0
-        self.role = ROLE.FOLLOWER
-        self.committed = 0
-        self.last_index = 0
-        self.first_index = 1
-        self.applied_since_snapshot = 0
-        self.snapshot_pending = False
+        # term adopted from an InstallSnapshot sender; the restore ack must
+        # carry it or the leader drops the ack as stale. Kept on the lane
+        # because the engine's _m_term mirror is rebound from device state
+        # every step (the device never saw the snapshot message).
+        self.adopted_term = 0
+        # slot -> [next_to_send, goal, match_at_progress, progress_tick]
+        self.catchup: Dict[int, list] = {}
+        # snapshot-status feedback (cf. feedback.go:38-128): slot ->
+        # (sent_tick, snapshot_index); a peer that does not ack the
+        # snapshot within the retry window gets a synthetic
+        # SNAPSHOT_STATUS reject so the kernel un-parks it and the
+        # leader retries — a lost InstallSnapshot must not wedge the
+        # remote in SNAPSHOT state forever
+        self.snap_inflight: Dict[int, Tuple[int, int]] = {}
         self.active = False
         self.cc_inflight = False
+        # (members, observers, witnesses) snapshot of the last membership
+        # image reconciled onto the device — config changes that restate
+        # the same image (e.g. bootstrap CCs) skip the device remap
+        self.mem_sig: Optional[tuple] = None
 
     # ------------------------------------------------------- slot mapping
     def set_slots(self, member_ids) -> Dict[int, int]:
@@ -272,6 +389,14 @@ class _Lane:
     def self_slot(self) -> int:
         return self.slots.get(self.node.node_id(), -1)
 
+    def has_staged(self) -> bool:
+        return bool(
+            self.msg_backlog
+            or self.staged_props
+            or self.staged_reads
+            or self.staged_ccs
+        )
+
 
 class VectorEngine:
     """Engine-compatible facade (add/remove/set_*_ready/stop) around the
@@ -294,30 +419,55 @@ class VectorEngine:
             max_entries_per_msg=8,
             readindex_depth=ecfg.readindex_depth if ecfg else 4,
         )
+        # multi-device: shard the group axis over every visible device
+        # (SURVEY §2.9.1 — groups are independent Raft instances, so the
+        # kernel partitions along G with zero collectives on the hot path)
+        self._sharding = None
+        if (
+            ecfg is not None
+            and getattr(ecfg, "shard_over_mesh", False)
+            and jax.device_count() > 1
+        ):
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            devs = jax.devices()
+            n = len(devs)
+            if self.kcfg.groups % n:
+                self.kcfg = self.kcfg._replace(
+                    groups=((self.kcfg.groups + n - 1) // n) * n
+                )
+            mesh = Mesh(np.array(devs), ("groups",))
+
+            def _shard_for(x, _mesh=mesh, _NS=NamedSharding, _P=PartitionSpec):
+                return _NS(
+                    _mesh, _P(*(("groups",) + (None,) * (x.ndim - 1)))
+                )
+
+            self._sharding = _shard_for
+        self.clock = _SharedClock()
         self._step_fn = make_step_fn(self.kcfg, donate=True)
         self._state: RaftTensors = init_state(self.kcfg)
+        if self._sharding is not None:
+            self._state = jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding(x)), self._state
+            )
         self._lanes: Dict[int, _Lane] = {}  # cluster_id -> lane
         self._free = list(range(self.kcfg.groups - 1, -1, -1))
         self._lanes_mu = threading.RLock()
         self._reconq: deque = deque()  # host->device ops, loop-applied
         self._stopped = threading.Event()
         self._ready = threading.Event()
-        # numpy staging buffers for the inbox (reused across steps)
-        G, K, E = self.kcfg.groups, self.kcfg.inbox_depth, 8
-        self._buf = {
-            "mtype": np.full((G, K), MSG.NONE, np.int32),
-            "from_slot": np.zeros((G, K), np.int32),
-            "term": np.zeros((G, K), np.int32),
-            "log_index": np.zeros((G, K), np.int32),
-            "log_term": np.zeros((G, K), np.int32),
-            "commit": np.zeros((G, K), np.int32),
-            "reject": np.zeros((G, K), bool),
-            "hint": np.zeros((G, K), np.int32),
-            "n_entries": np.zeros((G, K), np.int32),
-            "entry_terms": np.zeros((G, K, E), np.int32),
-            "entry_cc": np.zeros((G, K, E), bool),
-        }
-        self._ticks = np.zeros((G,), np.int32)
+        # ---- host-event staging (producers: API/transport threads) -------
+        self._dirty_mu = threading.Lock()
+        self._dirty: Set[int] = set()  # cluster ids with host events
+        self._gc_set: Set[int] = set()  # cluster ids with pending requests
+        self._pending_ticks = 0  # engine-global coalesced tick counter
+        # ---- loop-thread-only work sets ----------------------------------
+        self._carry: Set[_Lane] = set()  # lanes with leftover staged work
+        self._catchups: Set[_Lane] = set()  # lanes replaying host log
+        self._snapfb: Set[_Lane] = set()  # lanes with in-flight snapshots
+        self._alloc_buffers()
+        self._alloc_mirrors()
         # worker pools for apply + snapshot work (same split as ExecEngine)
         self._n_task = num_task_workers or min(
             soft.step_engine_task_worker_count, 4
@@ -344,6 +494,50 @@ class VectorEngine:
             t.start()
             self._threads.append(t)
 
+    def _alloc_buffers(self) -> None:
+        # numpy staging buffers for the inbox (reused across steps)
+        G, K, E = self.kcfg.groups, self.kcfg.inbox_depth, 8
+        self._buf = {
+            "mtype": np.full((G, K), MSG.NONE, np.int32),
+            "from_slot": np.zeros((G, K), np.int32),
+            "term": np.zeros((G, K), np.int32),
+            "log_index": np.zeros((G, K), np.int32),
+            "log_term": np.zeros((G, K), np.int32),
+            "commit": np.zeros((G, K), np.int32),
+            "reject": np.zeros((G, K), bool),
+            "hint": np.zeros((G, K), np.int32),
+            "n_entries": np.zeros((G, K), np.int32),
+            "entry_terms": np.zeros((G, K, E), np.int32),
+            "entry_cc": np.zeros((G, K, E), bool),
+        }
+        self._ticks = np.zeros((G,), np.int32)
+
+    def _alloc_mirrors(self) -> None:
+        """Whole-G numpy mirrors of per-lane protocol state, refreshed from
+        the StepOutput once per step (device units where applicable)."""
+        G = self.kcfg.groups
+        self._lane_by_g: List[Optional[_Lane]] = [None] * G
+        self._m_base = np.zeros(G, np.int64)  # real = device + base
+        self._m_devfirst = np.ones(G, np.int64)  # device-units first index
+        self._m_term = np.zeros(G, np.int32)
+        self._m_role = np.full(G, ROLE.FOLLOWER, np.int32)
+        self._m_leader = np.zeros(G, np.int32)  # slot+1, 0=none
+        self._m_commit = np.zeros(G, np.int64)  # device units
+        self._m_last = np.zeros(G, np.int64)  # device units
+        self._m_tick_cap = np.ones(G, np.int32)  # election_rtt per lane
+        self._m_active = np.zeros(G, bool)
+        self._m_snap_every = np.zeros(G, np.int64)  # cfg.snapshot_entries
+        self._m_applied_since = np.zeros(G, np.int64)
+        self._m_snap_pending = np.zeros(G, bool)
+        self._m_quiesced = np.zeros(G, bool)
+
+    # ------------------------------------------------------- mirror helpers
+    def _committed_real(self, g: int) -> int:
+        return int(self._m_base[g] + self._m_commit[g])
+
+    def _last_real(self, g: int) -> int:
+        return int(self._m_base[g] + self._m_last[g])
+
     # --------------------------------------------------------- registration
     def add_node(self, node: VectorNode) -> None:
         with self._lanes_mu:
@@ -354,6 +548,8 @@ class VectorEngine:
             g = self._free.pop()
             lane = _Lane(g, node)
             self._lanes[node.cluster_id] = lane
+            self._lane_by_g[g] = lane
+        node._vec_lane = lane
         self._reconq.append(("activate", lane))
         self.set_node_ready(node.cluster_id)
 
@@ -371,6 +567,16 @@ class VectorEngine:
 
     # -------------------------------------------------------------- wakeups
     def set_node_ready(self, cluster_id: int) -> None:
+        with self._dirty_mu:
+            self._dirty.add(cluster_id)
+            self._gc_set.add(cluster_id)
+        self._ready.set()
+
+    def global_tick(self) -> None:
+        """One logical tick for every lane (replaces per-lane LocalTick
+        messages; the host folds the count into the device tick array)."""
+        with self._dirty_mu:
+            self._pending_ticks += 1
         self._ready.set()
 
     def set_task_ready(self, cluster_id: int) -> None:
@@ -415,59 +621,126 @@ class VectorEngine:
 
     def _run_once(self) -> None:
         self._apply_reconciles()
-        with self._lanes_mu:
-            lanes = [ln for ln in self._lanes.values() if ln.active]
-        if not lanes:
-            return
-        had_work = self._pack(lanes)
-        if not had_work:
-            return
+        with self._dirty_mu:
+            dirty = self._dirty
+            self._dirty = set()
+            ticks = self._pending_ticks
+            self._pending_ticks = 0
+            gc_cids = list(self._gc_set) if ticks else ()
+        if ticks:
+            for _ in range(ticks):
+                self.clock.increase_tick()
+            self._run_gc(gc_cids)
+        work = self._carry
+        self._carry = set()
+        if dirty:
+            with self._lanes_mu:
+                for cid in dirty:
+                    lane = self._lanes.get(cid)
+                    if lane is not None and lane.active:
+                        work.add(lane)
+        work |= self._catchups
+        had = self._pack(work)
+        if not had:
+            if ticks == 0:
+                return
+            # no active lanes: ticks have nobody to advance
+            act = self._m_active
+            if not act.any():
+                return
+            # a fully-quiesced fleet needs no kernel step for ticks: every
+            # timer is frozen, so the step would be a no-op (this is what
+            # makes 10k+ idle lanes cost zero host AND device work)
+            if bool(np.all(~act | self._m_quiesced)):
+                return
+        if ticks:
+            np.minimum(self._m_tick_cap, ticks, out=self._ticks)
+            self._ticks *= self._m_active
+        else:
+            self._ticks.fill(0)
+        buf = self._buf
+        if self._sharding is not None:
+            put = lambda v: jax.device_put(v, self._sharding(v))
+        else:
+            put = jnp.asarray
         inbox = Inbox(
-            mtype=jnp.asarray(self._buf["mtype"]),
-            from_slot=jnp.asarray(self._buf["from_slot"]),
-            term=jnp.asarray(self._buf["term"]),
-            log_index=jnp.asarray(self._buf["log_index"]),
-            log_term=jnp.asarray(self._buf["log_term"]),
-            commit=jnp.asarray(self._buf["commit"]),
-            reject=jnp.asarray(self._buf["reject"]),
-            hint=jnp.asarray(self._buf["hint"]),
-            n_entries=jnp.asarray(self._buf["n_entries"]),
-            entry_terms=jnp.asarray(self._buf["entry_terms"]),
-            entry_cc=jnp.asarray(self._buf["entry_cc"]),
+            mtype=put(buf["mtype"]),
+            from_slot=put(buf["from_slot"]),
+            term=put(buf["term"]),
+            log_index=put(buf["log_index"]),
+            log_term=put(buf["log_term"]),
+            commit=put(buf["commit"]),
+            reject=put(buf["reject"]),
+            hint=put(buf["hint"]),
+            n_entries=put(buf["n_entries"]),
+            entry_terms=put(buf["entry_terms"]),
+            entry_cc=put(buf["entry_cc"]),
         )
-        ticks = jnp.asarray(self._ticks)
-        self._state, out = self._step_fn(self._state, inbox, ticks)
-        self._decode(lanes, out)
+        tarr = put(self._ticks)
+        self._state, out = self._step_fn(self._state, inbox, tarr)
+        self._decode(work, out)
+
+    def _run_gc(self, gc_cids) -> None:
+        """Request-timeout pass over lanes with outstanding requests only
+        (the reference runs four gc calls per node per tick; idle lanes
+        here cost nothing)."""
+        drop = []
+        for cid in gc_cids:
+            with self._lanes_mu:
+                lane = self._lanes.get(cid)
+            if lane is None:
+                drop.append(cid)
+                continue
+            node = lane.node
+            node.pending_proposals.gc()
+            node.pending_read_indexes.gc()
+            node.pending_config_change.gc()
+            node.pending_snapshot.gc()
+            if lane.ri_pending:
+                # engine-side ctx routing entries die with their batches
+                # (timed-out forwarded reads would otherwise leak here)
+                pri = node.pending_read_indexes
+                dead = [
+                    enc
+                    for enc, ctx in lane.ri_pending.items()
+                    if not pri.has_ctx(ctx)
+                ]
+                for enc in dead:
+                    del lane.ri_pending[enc]
+            if not (
+                node.pending_proposals.has_pending()
+                or node.pending_read_indexes.has_pending()
+                or node.pending_config_change.has_pending()
+                or node.pending_snapshot.has_pending()
+            ):
+                drop.append(cid)
+        if drop:
+            with self._dirty_mu:
+                # a request registered concurrently re-adds its cid to
+                # _dirty AND _gc_set (set_node_ready); keep those — else
+                # the new request's timeout gc would never run
+                self._gc_set.difference_update(set(drop) - self._dirty)
 
     # ---------------------------------------------------------------- pack
-    def _pack(self, lanes: List[_Lane]) -> bool:
+    def _pack(self, lanes: Set[_Lane]) -> bool:
         K = self.kcfg.inbox_depth
         E = self.kcfg.max_entries_per_msg
         buf = self._buf
         buf["mtype"].fill(MSG.NONE)
         buf["n_entries"].fill(0)
         buf["entry_cc"].fill(False)
-        self._ticks.fill(0)
-        had = False
+        had = bool(self._catchups)
         for lane in lanes:
             node = lane.node
             g = lane.g
             lane.pack_info = {}
-            msgs, ticks = node.mq.get()
-            if ticks:
-                capped = min(ticks, lane.cfg.election_rtt)
-                self._ticks[g] = capped
-                for _ in range(ticks):
-                    node.clock.increase_tick()
-                    node.pending_proposals.gc()
-                    node.pending_read_indexes.gc()
-                    node.pending_config_change.gc()
-                    node.pending_snapshot.gc()
-                had = True
+            msgs, _ = node.mq.get()
             lane.msg_backlog.extend(msgs)
             if lane.recovering:
                 # an InstallSnapshot recover is in flight: hold everything
                 # until the device lane is reconciled (cf. node.go:1199)
+                if lane.has_staged():
+                    self._carry.add(lane)
                 continue
             # drain API queues into the staging deques
             for e in node.incoming_proposals.get():
@@ -484,6 +757,19 @@ class VectorEngine:
                 )
                 lane.staged_ccs.append((ce, key))
             k = 0
+            # a quiesced lane with fresh host work gets a wake NOOP (the
+            # kernel exits quiesce on any non-heartbeat inbox message; the
+            # reference wakes through exitQuiesce on activity, quiesce.go)
+            if (
+                self._m_quiesced[g]
+                and k < K
+                and (lane.has_staged() or node.pending_leader_transfer.peek())
+            ):
+                self._pack_row(
+                    g, k, MSG.NOOP, from_slot=max(lane.self_slot(), 0)
+                )
+                had = True
+                k += 1
             # 1. wire/protocol messages first
             while lane.msg_backlog and k < K:
                 m = lane.msg_backlog.popleft()
@@ -491,8 +777,8 @@ class VectorEngine:
                 if k_used:
                     had = True
                     k += 1
-            is_leader = lane.role == ROLE.LEADER
-            leader_nid = lane.rev.get(lane.leader_slot)
+            is_leader = self._m_role[g] == ROLE.LEADER
+            leader_nid = lane.rev.get(int(self._m_leader[g]) - 1)
             # 2. one config change per step (lone message; host invariant)
             if k < K and lane.staged_ccs and not lane.cc_inflight:
                 if is_leader:
@@ -590,8 +876,10 @@ class VectorEngine:
                     )
                     had = True
                     k += 1
-            if lane.catchup:
-                had = True
+            # lanes with leftover staged work re-pack next iteration
+            # (K exhausted, or a leaderless lane waiting for an election)
+            if lane.has_staged():
+                self._carry.add(lane)
         return had
 
     def _pack_row(
@@ -630,7 +918,7 @@ class VectorEngine:
         from_slot = lane.slot_of(m.from_, provisional=t == MT.REPLICATE or t == MT.HEARTBEAT or t == MT.REQUEST_VOTE or t == MT.TIMEOUT_NOW or t == MT.READ_INDEX_RESP)
         if from_slot < 0 and m.from_ != 0:
             return False  # unknown sender and no room to learn it
-        b = lane.base
+        b = int(self._m_base[g])
         if t == MT.REPLICATE:
             n = len(m.entries)
             E = self.kcfg.max_entries_per_msg
@@ -675,6 +963,14 @@ class VectorEngine:
             )
             return True
         if t == MT.REPLICATE_RESP:
+            if m.reject and m.hint < b and from_slot >= 0:
+                # the follower's log ends BELOW our device window: the
+                # kernel cannot back off past its own first_index, so a
+                # clamped hint would loop rejects forever. Serve the gap
+                # host-side (log replay or snapshot) and park the device
+                # remote until the follower crosses the window base.
+                self._below_window_reject(lane, from_slot, m)
+                return False
             self._pack_row(
                 g, k, MSG.REPLICATE_RESP, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, reject=m.reject,
@@ -719,11 +1015,36 @@ class VectorEngine:
 
     def _handle_install_snapshot(self, lane: _Lane, m: Message) -> None:
         ss = m.snapshot
+        node = lane.node
         if ss is None or ss.is_empty():
             return
-        if ss.index <= lane.node.sm.last_applied_index():
-            return  # stale snapshot
+        applied = node.sm.last_applied_index()
+        if ss.index <= applied:
+            # stale snapshot: ACK it (etcd TestRestoreIgnores semantics —
+            # the scalar core does the same). A silent drop wedges the
+            # sender: its remote stays parked in SNAPSHOT state waiting for
+            # match >= snapshot index, it resends the same snapshot on the
+            # feedback retry, and we'd drop that too, forever.
+            node._send_message(
+                Message(
+                    type=MT.REPLICATE_RESP,
+                    cluster_id=node.cluster_id,
+                    to=m.from_,
+                    from_=node.node_id(),
+                    term=max(m.term, int(self._m_term[lane.g]),
+                             lane.adopted_term),
+                    log_index=applied,
+                )
+            )
+            return
+        if lane.recovering:
+            return  # a restore is already in flight; the retry re-delivers
         lane.recovering = True
+        # the restore ack must carry a term the sender will not drop as
+        # stale; the kernel never sees this message (it is consumed host-
+        # side), so remember the sender's term for the ack path
+        # (cf. raft.go:1415-1449 term preamble)
+        lane.adopted_term = max(lane.adopted_term, m.term)
         # persist the snapshot record before recovery (restart safety)
         self._logdb.save_raft_state(
             [
@@ -737,26 +1058,29 @@ class VectorEngine:
         lane.node._push_install_snapshot(ss)
 
     # --------------------------------------------------------------- decode
-    def _decode(self, lanes: List[_Lane], out) -> None:
-        o = {k: np.asarray(v) for k, v in out._asdict().items()}
-        E = self.kcfg.max_entries_per_msg
-        K = self.kcfg.inbox_depth
+    def _decode(self, worked: Set[_Lane], out) -> None:
+        # ONE consolidated device->host transfer for the whole StepOutput
+        o = jax.device_get(out)._asdict()
+        lane_by_g = self._lane_by_g
+        base = self._m_base
         updates: List[Update] = []
         lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
         # ---- phase 0: place payloads at device-assigned indexes ----------
-        for lane in lanes:
+        for lane in worked:
+            if not lane.pack_info:
+                continue
             g = lane.g
-            b = lane.base
+            b = int(base[g])
             node = lane.node
             for k, info in lane.pack_info.items():
                 kind = info[0]
                 if kind == "prop":
                     ents = info[1]
-                    base = int(o["prop_base"][g, k])
-                    if base > 0:
+                    pbase = int(o["prop_base"][g, k])
+                    if pbase > 0:
                         term = int(o["resp_term"][g, k])
                         for i, e in enumerate(ents):
-                            e.index = b + base + i
+                            e.index = b + pbase + i
                             e.term = term
                             lane.arena[e.index] = e
                     else:
@@ -764,56 +1088,73 @@ class VectorEngine:
                             node.pending_proposals.dropped(e.key)
                 elif kind == "cc":
                     ce, key = info[1], info[2]
-                    base = int(o["prop_base"][g, k])
+                    pbase = int(o["prop_base"][g, k])
                     stripped = bool(o["dropped_cc"][g])
-                    if base > 0 and not stripped:
-                        ce.index = b + base
+                    if pbase > 0 and not stripped:
+                        ce.index = b + pbase
                         ce.term = int(o["resp_term"][g, k])
                         lane.arena[ce.index] = ce
                     else:
-                        if base > 0:
+                        if pbase > 0:
                             # the kernel appended the entry with its cc bit
                             # stripped (single-pending invariant): it lives
                             # on as an empty noop entry (raft.go:1587-1606)
-                            lane.arena[b + base] = Entry(
+                            lane.arena[b + pbase] = Entry(
                                 type=EntryType.APPLICATION,
-                                index=b + base,
+                                index=b + pbase,
                                 term=int(o["resp_term"][g, k]),
                             )
                         lane.cc_inflight = False
                         node.pending_config_change.apply(key, rejected=True)
                 elif kind == "rep":
-                    base = int(o["rep_base"][g, k])
-                    if base > 0:
+                    rbase = int(o["rep_base"][g, k])
+                    if rbase > 0:
                         for e in info[1]:
                             lane.arena[e.index] = e
+            lane.pack_info = {}
+        # new-leader noop entries can appear on ANY lane (tick elections)
+        for g in np.nonzero(o["noop_appended"])[0].tolist():
+            lane = lane_by_g[g]
+            if lane is None:
+                continue
             noop_at = int(o["noop_appended"][g])
-            if noop_at > 0:
-                lane.arena[b + noop_at] = Entry(
-                    type=EntryType.APPLICATION,
-                    term=int(o["noop_term"][g]),
-                    index=b + noop_at,
-                )
-            # mirrors
-            lane.leader_slot = int(o["leader"][g]) - 1
-            lane.term = int(o["term"][g])
-            lane.role = int(o["role"][g])
-            lane.committed = b + int(o["commit_index"][g])
-            lane.last_index = b + int(o["last_index"][g])
-            leader_nid = lane.rev.get(lane.leader_slot, 0)
-            node._set_status(leader_nid, lane.term, lane.role, lane.committed)
+            lane.arena[int(base[g]) + noop_at] = Entry(
+                type=EntryType.APPLICATION,
+                term=int(o["noop_term"][g]),
+                index=int(base[g]) + noop_at,
+            )
+        # ---- mirror refresh + leader-change events -----------------------
+        new_leader = o["leader"]
+        new_term = o["term"]
+        changed = np.nonzero(
+            ((new_leader != self._m_leader) | (new_term != self._m_term))
+            & self._m_active
+        )[0]
+        # device_get arrays can be read-only views: mirrors are mutated by
+        # the activation/reconcile paths, so copy on rebind
+        self._m_leader = np.array(new_leader)
+        self._m_term = np.array(new_term)
+        self._m_role = np.array(o["role"])
+        self._m_quiesced = np.array(o["quiesced"])
+        self._m_commit = o["commit_index"].astype(np.int64)
+        self._m_last = o["last_index"].astype(np.int64)
+        for g in changed.tolist():
+            lane = lane_by_g[g]
+            if lane is None or not lane.active:
+                continue
+            nid = lane.rev.get(int(new_leader[g]) - 1, 0)
+            lane.node._leader_event(nid, int(new_term[g]))
         # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
         send_flags = o["send_flags"]
         rep_gs, rep_ps = np.nonzero(send_flags & SEND_REPLICATE)
-        by_g = {lane.g: lane for lane in lanes}
         for g, p in zip(rep_gs.tolist(), rep_ps.tolist()):
-            lane = by_g.get(g)
+            lane = lane_by_g[g]
             if lane is None:
                 continue
             to_nid = lane.rev.get(p)
             if to_nid is None:
                 continue
-            b = lane.base
+            b = int(base[g])
             prev = int(o["send_prev_index"][g, p])
             n = int(o["send_n_entries"][g, p])
             try:
@@ -838,9 +1179,12 @@ class VectorEngine:
                 )
             )
         # ---- phase 2: one batched fsynced write for every lane -----------
-        for lane in lanes:
-            g = lane.g
-            b = lane.base
+        save_gs = np.nonzero((o["save_from"] > 0) | o["hard_changed"])[0]
+        for g in save_gs.tolist():
+            lane = lane_by_g[g]
+            if lane is None or not lane.active:
+                continue
+            b = int(base[g])
             sf, st_ = int(o["save_from"][g]), int(o["save_to"][g])
             ents: List[Entry] = []
             if sf > 0:
@@ -883,7 +1227,7 @@ class VectorEngine:
         ):
             gs, ps = np.nonzero(send_flags & flag)
             for g, p in zip(gs.tolist(), ps.tolist()):
-                lane = by_g.get(g)
+                lane = lane_by_g[g]
                 if lane is None:
                     continue
                 to_nid = lane.rev.get(p)
@@ -892,23 +1236,26 @@ class VectorEngine:
                 lane.node._send_message(mk(lane, o, g, p, to_nid))
         resp_gs, resp_ks = np.nonzero(o["resp_type"] != MSG.NONE)
         for g, k in zip(resp_gs.tolist(), resp_ks.tolist()):
-            lane = by_g.get(g)
+            lane = lane_by_g[g]
             if lane is None:
                 continue
             self._send_resp(lane, o, g, k)
         # snapshot path for peers that fell behind the device window
         snap_gs, snap_ps = np.nonzero(send_flags & NEED_SNAPSHOT)
         for g, p in zip(snap_gs.tolist(), snap_ps.tolist()):
-            lane = by_g.get(g)
+            lane = lane_by_g[g]
             if lane is not None:
                 self._start_catchup(lane, p, o)
         # ---- phase 4: hand committed entries to the RSM ------------------
-        for lane in lanes:
-            g = lane.g
-            b = lane.base
-            af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
-            if af <= 0:
+        from ..rsm import Task
+
+        apply_gs = np.nonzero(o["apply_from"])[0]
+        for g in apply_gs.tolist():
+            lane = lane_by_g[g]
+            if lane is None or not lane.active:
                 continue
+            b = int(base[g])
+            af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
             ents = []
             missing = False
             for idx in range(b + af, b + at + 1):
@@ -923,8 +1270,6 @@ class VectorEngine:
                 ents.append(e)
             if missing or not ents:
                 continue
-            from ..rsm import Task
-
             lane.node.sm.task_queue.add(
                 Task(
                     cluster_id=lane.node.cluster_id,
@@ -932,20 +1277,21 @@ class VectorEngine:
                     entries=ents,
                 )
             )
-            lane.applied_since_snapshot += len(ents)
+            self._m_applied_since[g] += len(ents)
             if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
                 lane.cc_inflight = False
             self.set_task_ready(lane.node.cluster_id)
         # ---- phase 5: confirmed reads ------------------------------------
-        for lane in lanes:
-            g = lane.g
-            n = int(o["ready_count"][g])
-            if n == 0:
+        ready_gs = np.nonzero(o["ready_count"])[0]
+        for g in ready_gs.tolist():
+            lane = lane_by_g[g]
+            if lane is None or not lane.active:
                 continue
+            n = int(o["ready_count"][g])
             node = lane.node
             for i in range(n):
                 enc = int(o["ready_ctx"][g, i])
-                idx = lane.base + int(o["ready_index"][g, i])
+                idx = int(base[g]) + int(o["ready_index"][g, i])
                 origin = _ctx_origin(enc)
                 if origin == lane.self_slot():
                     ctx = lane.ri_pending.pop(enc, None)
@@ -962,14 +1308,14 @@ class VectorEngine:
                                 cluster_id=node.cluster_id,
                                 to=to_nid,
                                 from_=node.node_id(),
-                                term=lane.term,
+                                term=int(self._m_term[g]),
                                 log_index=idx,
                                 hint=enc,
                             )
                         )
             node.pending_read_indexes.applied(node.sm.last_applied_index())
         # ---- phase 6: maintenance ----------------------------------------
-        self._maintain(lanes, o)
+        self._maintain(o)
 
     def _mk_vote(self, lane, o, g, p, to_nid) -> Message:
         return Message(
@@ -978,7 +1324,7 @@ class VectorEngine:
             to=to_nid,
             from_=lane.node.node_id(),
             term=int(o["term"][g]),
-            log_index=lane.base + int(o["vote_last_index"][g]),
+            log_index=int(self._m_base[g]) + int(o["vote_last_index"][g]),
             log_term=int(o["vote_last_term"][g]),
             hint=int(o["send_hint"][g, p]),
         )
@@ -990,7 +1336,7 @@ class VectorEngine:
             to=to_nid,
             from_=lane.node.node_id(),
             term=int(o["term"][g]),
-            commit=lane.base + int(o["send_hb_commit"][g, p]),
+            commit=int(self._m_base[g]) + int(o["send_hb_commit"][g, p]),
             hint=int(o["send_hint"][g, p]),
         )
 
@@ -1011,7 +1357,7 @@ class VectorEngine:
             return
         if to_nid == lane.node.node_id():
             return  # self-addressed (e.g. local election artifacts)
-        b = lane.base
+        b = int(self._m_base[g])
         wire = {
             MSG.REPLICATE_RESP: MT.REPLICATE_RESP,
             MSG.REQUEST_VOTE_RESP: MT.REQUEST_VOTE_RESP,
@@ -1040,6 +1386,32 @@ class VectorEngine:
         )
 
     # ------------------------------------------------------ catchup path
+    def _below_window_reject(self, lane: _Lane, p: int, m: Message) -> None:
+        """A reject whose hint is below the device window base: replicate
+        the gap from the host log (or ship a snapshot), with the device
+        remote parked so it stops probing indexes the follower cannot
+        match. The park watermark is base+1: the first ack at or above the
+        window base un-parks it and device replication takes over."""
+        g = lane.g
+        if p in lane.catchup or p in lane.snap_inflight:
+            return  # recovery already running for this peer
+        if int(self._m_role[g]) != ROLE.LEADER:
+            return
+        b = int(self._m_base[g])
+        s = self._state
+        self._state = s._replace(
+            rstate=s.rstate.at[g, p].set(RSTATE.SNAPSHOT),
+            snap_sent=s.snap_sent.at[g, p].set(1),
+        )
+        start = m.hint + 1
+        goal = self._last_real(g)
+        first, last = lane.node.log_reader.get_range()
+        if start >= first and start <= last + 1:
+            lane.catchup[p] = [start, goal, m.hint, self.clock.tick]
+            self._catchups.add(lane)
+        else:
+            self._send_snapshot(lane, p)
+
     def _start_catchup(self, lane: _Lane, p: int, o) -> None:
         """A peer's next index fell behind the device window. If the host
         log still has the entries, replicate them host-side (the device has
@@ -1049,13 +1421,15 @@ class VectorEngine:
         if p in lane.catchup:
             return
         g = lane.g
-        goal = lane.base + int(o["last_index"][g])
-        match = lane.base + int(o["match"][g, p])
+        b = int(self._m_base[g])
+        goal = b + int(o["last_index"][g])
+        match = b + int(o["match"][g, p])
         start = match + 1
         first, last = lane.node.log_reader.get_range()
         if start >= first and start <= last + 1:
-            # [next_to_send, goal, match_at_last_progress, stall_rounds]
-            lane.catchup[p] = [start, goal, match, 0]
+            # [next_to_send, goal, match_at_progress, progress_tick]
+            lane.catchup[p] = [start, goal, match, self.clock.tick]
+            self._catchups.add(lane)
         else:
             # the follower needs entries the host log no longer has
             # (compacted behind a snapshot): only a snapshot can help
@@ -1073,6 +1447,11 @@ class VectorEngine:
                 "%s peer %d needs a snapshot but none exists",
                 lane.node.describe(), to_nid,
             )
+            # still arm the feedback timer: the synthetic reject will
+            # un-park the peer so host-log replication retries instead of
+            # wedging it in SNAPSHOT state
+            lane.snap_inflight[p] = (self.clock.tick, 0)
+            self._snapfb.add(lane)
             return
         lane.node._send_message(
             Message(
@@ -1080,32 +1459,47 @@ class VectorEngine:
                 cluster_id=lane.node.cluster_id,
                 to=to_nid,
                 from_=lane.node.node_id(),
-                term=lane.term,
+                term=int(self._m_term[lane.g]),
                 snapshot=ss,
             )
         )
+        # reconcile the device's parked-peer watermark to the snapshot
+        # ACTUALLY sent (the kernel parked it at the leader's last index):
+        # the remote un-parks once match >= snap_sent (remote.go:62-69,
+        # 145-153), so the watermark must be reachable by restoring this
+        # snapshot or the peer wedges in SNAPSHOT state forever
+        g = lane.g
+        dev_idx = max(int(ss.index - self._m_base[g]), 0)
+        s = self._state
+        self._state = s._replace(
+            snap_sent=s.snap_sent.at[g, p].set(dev_idx)
+        )
+        lane.snap_inflight[p] = (self.clock.tick, ss.index)
+        self._snapfb.add(lane)
 
     def _run_catchups(self, lane: _Lane, o) -> None:
         if not lane.catchup:
+            self._catchups.discard(lane)
             return
         g = lane.g
+        b = int(self._m_base[g])
+        # a follower that stops acking for two election timeouts is treated
+        # as lost (the same silence bound the protocol uses to declare a
+        # leader dead) and falls back to the snapshot path
+        stall_ticks = max(2 * lane.cfg.election_rtt, 8)
         done = []
         for p, cu in lane.catchup.items():
-            nxt, goal, last_match, stall = cu
-            match = lane.base + int(o["match"][g, p])
-            if match >= goal or lane.role != ROLE.LEADER:
+            nxt, goal, last_match, progress_tick = cu
+            match = b + int(o["match"][g, p])
+            if match >= goal or int(self._m_role[g]) != ROLE.LEADER:
                 done.append(p)
                 continue
             if match > last_match:
-                cu[2], cu[3] = match, 0
-            else:
-                cu[3] = stall + 1
-                if cu[3] > 500:
-                    # the follower stopped acking (divergence, loss): give
-                    # up on log replay and ship a snapshot instead
-                    done.append(p)
-                    self._send_snapshot(lane, p)
-                    continue
+                cu[2], cu[3] = match, self.clock.tick
+            elif self.clock.tick - progress_tick > stall_ticks:
+                done.append(p)
+                self._send_snapshot(lane, p)
+                continue
             if match + 1 > nxt:
                 nxt = match + 1
             first, last = lane.node.log_reader.get_range()
@@ -1139,112 +1533,183 @@ class VectorEngine:
                     cluster_id=lane.node.cluster_id,
                     to=to_nid,
                     from_=lane.node.node_id(),
-                    term=lane.term,
+                    term=int(self._m_term[g]),
                     log_index=prev,
                     log_term=prev_term,
-                    commit=min(lane.committed, ents[-1].index),
+                    commit=min(self._committed_real(g), ents[-1].index),
                     entries=ents,
                 )
             )
             cu[0] = ents[-1].index + 1
         for p in done:
             lane.catchup.pop(p, None)
+        if not lane.catchup:
+            self._catchups.discard(lane)
+
+    def _run_snapshot_feedback(self, lane: _Lane, o) -> None:
+        """Delayed snapshot-status retry (cf. feedback.go:38-128): an
+        InstallSnapshot that is not acked within the retry window gets a
+        synthetic SNAPSHOT_STATUS reject queued to the local lane. The
+        kernel then moves the remote SNAPSHOT->WAIT (next=match+1); the
+        following HeartbeatResp probes it, and replication — or another
+        snapshot — retries. Without this, a snapshot lost to a partition
+        wedges the remote in SNAPSHOT state forever."""
+        if not lane.snap_inflight:
+            self._snapfb.discard(lane)
+            return
+        g = lane.g
+        b = int(self._m_base[g])
+        retry_ticks = max(4 * lane.cfg.election_rtt, 16)
+        is_leader = int(self._m_role[g]) == ROLE.LEADER
+        done = []
+        for p, (sent_tick, ss_index) in lane.snap_inflight.items():
+            match = b + int(o["match"][g, p])
+            if not is_leader or (ss_index > 0 and match >= ss_index):
+                done.append(p)  # acked (or leadership moved on)
+                continue
+            if self.clock.tick - sent_tick > retry_ticks:
+                done.append(p)
+                from_nid = lane.rev.get(p)
+                if from_nid is not None:
+                    lane.node.mq.add(
+                        Message(
+                            type=MT.SNAPSHOT_STATUS,
+                            cluster_id=lane.node.cluster_id,
+                            to=lane.node.node_id(),
+                            from_=from_nid,
+                            reject=True,
+                        )
+                    )
+                    self.set_node_ready(lane.node.cluster_id)
+        for p in done:
+            lane.snap_inflight.pop(p, None)
+        if not lane.snap_inflight:
+            self._snapfb.discard(lane)
 
     # --------------------------------------------------------- maintenance
-    def _maintain(self, lanes: List[_Lane], o) -> None:
+    def _maintain(self, o) -> None:
         W = self.kcfg.log_window
+        lane_by_g = self._lane_by_g
+        for lane in list(self._catchups):
+            self._run_catchups(lane, o)
+        for lane in list(self._snapfb):
+            self._run_snapshot_feedback(lane, o)
+        # periodic snapshot by applied-entry count (node.go:585-601); a
+        # wedged window forces one regardless of config. Candidates are
+        # found vectorized; only triggering lanes cost Python.
+        log_full = o["log_full"]
+        snap_due = (
+            self._m_active
+            & ~self._m_snap_pending
+            & (
+                log_full
+                | (
+                    (self._m_snap_every > 0)
+                    & (self._m_applied_since >= self._m_snap_every)
+                )
+            )
+        )
+        for g in np.nonzero(snap_due)[0].tolist():
+            lane = lane_by_g[g]
+            if lane is None or lane.node.snapshotter is None:
+                continue
+            applied, _ = lane.node.sm.get_last_applied()
+            if applied > 0 and not lane.cfg.is_witness:
+                self._m_snap_pending[g] = True
+                self._m_applied_since[g] = 0
+                from ..rsm import SSRequest
+
+                lane.node.push_take_snapshot_request(SSRequest())
+        # device window compaction: advance first_index once the window is
+        # half full; applied entries are recoverable from the host log
+        # (catchup path) or a snapshot, so the device needs neither
+        used = o["last_index"].astype(np.int64) - self._m_devfirst + 1
+        compact_due = self._m_active & ((used > W // 2) | log_full)
         advance_g: List[int] = []
         advance_first: List[int] = []
         advance_term: List[int] = []
-        need_rebase = False
-        for lane in lanes:
-            g = lane.g
-            self._run_catchups(lane, o)
-            # periodic snapshot by applied-entry count (node.go:585-601);
-            # a wedged window forces one regardless of config
-            se = lane.cfg.snapshot_entries
-            log_full = bool(o["log_full"][g])
-            if (
-                (se > 0 and lane.applied_since_snapshot >= se) or log_full
-            ) and not lane.snapshot_pending and lane.node.snapshotter is not None:
-                applied, _ = lane.node.sm.get_last_applied()
-                if applied > 0 and not lane.cfg.is_witness:
-                    lane.snapshot_pending = True
-                    lane.applied_since_snapshot = 0
-                    from ..rsm import SSRequest
-
-                    lane.node.push_take_snapshot_request(SSRequest())
-            # device window compaction: advance first_index once the window
-            # is half full; applied entries are recoverable from the host
-            # log (catchup path) or a snapshot, so the device needs neither
-            used = lane.last_index - (lane.base + lane.first_index) + 1
+        for g in np.nonzero(compact_due)[0].tolist():
+            lane = lane_by_g[g]
+            if lane is None:
+                continue
+            b = int(self._m_base[g])
             applied, applied_term = lane.node.sm.get_last_applied()
-            target = min(applied, lane.committed)
-            if (used > W // 2 or log_full) and target + 1 > lane.base + lane.first_index:
-                lane.first_index = target - lane.base + 1
+            target = min(applied, self._committed_real(g))
+            if target + 1 > b + int(self._m_devfirst[g]):
+                first_new = target - b + 1
+                self._m_devfirst[g] = first_new
                 advance_g.append(g)
-                advance_first.append(lane.first_index)
+                advance_first.append(first_new)
                 advance_term.append(applied_term)
                 # prune the arena below the window (payloads now live in
                 # logdb/log_reader only)
                 for idx in [i for i in lane.arena if i < target + 1]:
                     del lane.arena[idx]
-            if lane.last_index - lane.base > _REBASE_THRESHOLD:
-                need_rebase = True
         if advance_g:
-            G = self.kcfg.groups
-            mask = np.zeros((G,), bool)
-            firsts = np.zeros((G,), np.int32)
-            terms = np.zeros((G,), np.int32)
-            mask[advance_g] = True
-            firsts[advance_g] = advance_first
-            terms[advance_g] = advance_term
+            gs = jnp.asarray(np.asarray(advance_g, np.int32))
             s = self._state
-            m = jnp.asarray(mask)
             self._state = s._replace(
-                first_index=jnp.where(m, jnp.asarray(firsts), s.first_index),
-                marker_term=jnp.where(m, jnp.asarray(terms), s.marker_term),
+                first_index=s.first_index.at[gs].set(
+                    jnp.asarray(np.asarray(advance_first, np.int32))
+                ),
+                marker_term=s.marker_term.at[gs].set(
+                    jnp.asarray(np.asarray(advance_term, np.int32))
+                ),
             )
-        if need_rebase:
-            self._do_rebase(lanes)
+        if bool(np.any(o["last_index"] > _REBASE_THRESHOLD)):
+            self._do_rebase()
 
-    def _do_rebase(self, lanes: List[_Lane]) -> None:
+    def _do_rebase(self) -> None:
         """Shift device indexes down so they never near 2**31. The delta is
         a multiple of W (ring-slot invariant, cf. ops/state.rebase)."""
         W = self.kcfg.log_window
         G = self.kcfg.groups
         delta = np.zeros((G,), np.int32)
+        with self._lanes_mu:
+            lanes = [ln for ln in self._lanes.values() if ln.active]
         for lane in lanes:
-            d = ((lane.first_index - 1) // W) * W
+            g = lane.g
+            d = int((self._m_devfirst[g] - 1) // W) * W
             if d > 0:
-                delta[lane.g] = d
-                lane.base += d
-                lane.first_index -= d
+                delta[g] = d
+                self._m_base[g] += d
+                self._m_devfirst[g] -= d
+                self._m_commit[g] -= d
+                self._m_last[g] -= d
         if delta.any():
             self._state = rebase(self._state, jnp.asarray(delta))
 
     # ----------------------------------------------------------- reconciles
     def _apply_reconciles(self) -> None:
-        while self._reconq:
-            op = self._reconq.popleft()
+        batch: List[_Lane] = []
+        cc_clear: List[int] = []
+        while True:
+            try:
+                op = self._reconq.popleft()
+            except IndexError:
+                break
+            if op[0] == "activate":
+                batch.append(op[1])
+                continue
+            if op[0] == "cc_done":
+                # batched below: one fixed-shape mask op instead of a
+                # per-lane scatter (bootstrap emits one per cluster)
+                lane = self._lane_of(op[1])
+                if lane is not None and lane.active:
+                    cc_clear.append(lane.g)
+                    lane.cc_inflight = False
+                continue
+            if batch:
+                self._activate_batch(batch)
+                batch = []
             try:
                 kind = op[0]
-                if kind == "activate":
-                    self._activate(op[1])
-                elif kind == "deactivate":
+                if kind == "deactivate":
                     self._deactivate(op[1])
                 elif kind == "membership":
                     self._reconcile_membership(op[1])
                 elif kind == "restore":
                     self._reconcile_restore(op[1], op[2])
-                elif kind == "cc_done":
-                    lane = self._lane_of(op[1])
-                    if lane is not None and lane.active:
-                        s = self._state
-                        self._state = s._replace(
-                            pending_cc=s.pending_cc.at[lane.g].set(False)
-                        )
-                        lane.cc_inflight = False
                 elif kind == "recover_done":
                     lane = self._lane_of(op[1])
                     if lane is not None:
@@ -1253,15 +1718,25 @@ class VectorEngine:
                 import traceback
 
                 traceback.print_exc()
+        if batch:
+            self._activate_batch(batch)
+        if cc_clear:
+            mask = np.zeros((self.kcfg.groups,), bool)
+            mask[cc_clear] = True
+            s = self._state
+            self._state = s._replace(
+                pending_cc=s.pending_cc & jnp.asarray(~mask)
+            )
 
     def _lane_of(self, node) -> Optional[_Lane]:
         with self._lanes_mu:
             return self._lanes.get(node.cluster_id)
 
-    def _activate(self, lane: _Lane) -> None:
-        """Bring a lane live: bootstrap (initial start), restart replay, or
-        join-as-empty. Mirrors Peer.launch + node.replayLog
-        (cf. core/peer.py:75-94, node.go:553-583)."""
+    def _compute_activation(self, lane: _Lane) -> Optional[dict]:
+        """Host-side half of lane bring-up: bootstrap (initial start),
+        restart replay, or join-as-empty. Mirrors Peer.launch +
+        node.replayLog (cf. core/peer.py:75-94, node.go:553-583). Returns
+        the per-field device values for the batched scatter."""
         node = lane.node
         node.recover_initial_snapshot()
         cfg = lane.cfg
@@ -1283,6 +1758,9 @@ class VectorEngine:
         wit_ids = set(mem.witnesses)
         if not mem.addresses and bootstrap:
             obs_ids, wit_ids = set(), set()
+        lane.mem_sig = (
+            frozenset(member_ids), frozenset(obs_ids), frozenset(wit_ids)
+        )
         # persisted protocol state
         st = self._logdb_state(node)
         snap = node.snapshotter.get_most_recent_snapshot() if node.snapshotter else None
@@ -1322,29 +1800,26 @@ class VectorEngine:
             term = max(term, 1)
         elif node._vec_new_node and not cfg.is_observer and not cfg.is_witness:
             term = max(term, 1)
-        base = snap_index
-        lane.base = base
+        b = snap_index
         last_real = ents[-1].index if ents else max(snap_index, last if last else 0)
-        dev_last = max(last_real - base, 0)
+        dev_last = max(last_real - b, 0)
         dev_first = max(dev_last - W + 1, 1)
-        lane.first_index = dev_first
-        lane.committed = max(committed, snap_index)
-        lane.last_index = last_real
+        committed = max(committed, snap_index)
         # ring metadata from the replayed entries
         ring_terms = np.zeros((W,), np.int32)
         ring_cc = np.zeros((W,), bool)
         for e in ents:
             lane.arena[e.index] = e
-            di = e.index - base
+            di = e.index - b
             if dev_first <= di <= dev_last:
                 ring_terms[di % W] = e.term
                 ring_cc[di % W] = e.type == EntryType.CONFIG_CHANGE
         marker = dev_first - 1
         if marker == 0:
-            marker_term = snap.term if snap_index and base == snap_index else 0
+            marker_term = snap.term if snap_index and b == snap_index else 0
         else:
             try:
-                marker_term = node.log_reader.term(base + marker)
+                marker_term = node.log_reader.term(b + marker)
             except Exception:
                 marker_term = 0
         member = np.zeros((P,), bool)
@@ -1352,7 +1827,11 @@ class VectorEngine:
         observer = np.zeros((P,), bool)
         witness = np.zeros((P,), bool)
         for nid, slot in lane.slots.items():
-            if slot >= P:
+            if slot >= P or nid not in member_ids:
+                # provisional parkings (the join path parks self and
+                # learned senders on free slots) are NOT members: marking
+                # them voting would let an empty-membership join lane
+                # self-elect as a one-node group and poison its log
                 continue
             member[slot] = True
             if nid in obs_ids:
@@ -1368,60 +1847,127 @@ class VectorEngine:
             else ROLE.FOLLOWER
         )
         vote_slot = lane.slots.get(vote_nid, -1)
-        s = self._state
-        seed = int(np.asarray(s.seed[g]))
-        from ..ops.state import _mix
-
         et = max(cfg.election_rtt, 3)
         hb = max(cfg.heartbeat_rtt, 1)
-        upd = dict(
-            active=s.active.at[g].set(True),
-            self_slot=s.self_slot.at[g].set(max(self_slot, 0)),
-            member=s.member.at[g].set(jnp.asarray(member)),
-            voting=s.voting.at[g].set(jnp.asarray(voting)),
-            observer=s.observer.at[g].set(jnp.asarray(observer)),
-            witness=s.witness.at[g].set(jnp.asarray(witness)),
-            term=s.term.at[g].set(term),
-            vote=s.vote.at[g].set(vote_slot + 1 if vote_slot >= 0 else 0),
-            role=s.role.at[g].set(role),
-            leader=s.leader.at[g].set(0),
-            tick_count=s.tick_count.at[g].set(0),
-            election_tick=s.election_tick.at[g].set(0),
-            heartbeat_tick=s.heartbeat_tick.at[g].set(0),
-            election_timeout=s.election_timeout.at[g].set(et),
-            heartbeat_timeout=s.heartbeat_timeout.at[g].set(hb),
-            rand_timeout=s.rand_timeout.at[g].set(
-                et + _mix(seed, term, max(self_slot, 0)) % et
-            ),
-            check_quorum=s.check_quorum.at[g].set(cfg.check_quorum),
-            first_index=s.first_index.at[g].set(dev_first),
-            marker_term=s.marker_term.at[g].set(marker_term),
-            last_index=s.last_index.at[g].set(dev_last),
-            committed=s.committed.at[g].set(lane.committed - base),
-            processed=s.processed.at[g].set(max(snap_index - base, 0)),
-            applied=s.applied.at[g].set(max(snap_index - base, 0)),
-            unsaved_from=s.unsaved_from.at[g].set(
-                1 if bootstrap else dev_last + 1
-            ),
-            log_term=s.log_term.at[g].set(jnp.asarray(ring_terms)),
-            log_is_cc=s.log_is_cc.at[g].set(jnp.asarray(ring_cc)),
-            match=s.match.at[g].set(0),
-            next=s.next.at[g].set(dev_last + 1),
-            rstate=s.rstate.at[g].set(RSTATE.RETRY),
-            ract=s.ract.at[g].set(False),
-            snap_sent=s.snap_sent.at[g].set(0),
-            vresp=s.vresp.at[g].set(False),
-            vgrant=s.vgrant.at[g].set(False),
-            transfer_to=s.transfer_to.at[g].set(0),
-            transfer_flag=s.transfer_flag.at[g].set(False),
-            pending_cc=s.pending_cc.at[g].set(False),
-            ri_ctx=s.ri_ctx.at[g].set(0),
-            ri_index=s.ri_index.at[g].set(0),
-            ri_acks=s.ri_acks.at[g].set(0),
-            ri_count=s.ri_count.at[g].set(0),
+        from ..ops.state import _mix
+
+        rand_to = et + _mix(lane_seed(g), term, max(self_slot, 0)) % et
+        # quiesce threshold: 10x the election timeout (cf. quiesce.go:84-86)
+        quiesce_on = bool(cfg.quiesce)
+        quiesce_threshold = 10 * et
+        # ---- numpy mirrors ------------------------------------------------
+        self._m_base[g] = b
+        self._m_devfirst[g] = dev_first
+        self._m_term[g] = term
+        self._m_role[g] = role
+        self._m_leader[g] = 0
+        self._m_commit[g] = committed - b
+        self._m_last[g] = dev_last
+        self._m_tick_cap[g] = max(cfg.election_rtt, 1)
+        self._m_active[g] = True
+        self._m_snap_every[g] = cfg.snapshot_entries
+        self._m_applied_since[g] = 0
+        self._m_snap_pending[g] = False
+        self._m_quiesced[g] = False  # a reused lane must not inherit this
+        return dict(
+            self_slot=max(self_slot, 0),
+            member=member,
+            voting=voting,
+            observer=observer,
+            witness=witness,
+            term=term,
+            vote=vote_slot + 1 if vote_slot >= 0 else 0,
+            role=role,
+            election_timeout=et,
+            heartbeat_timeout=hb,
+            rand_timeout=rand_to,
+            check_quorum=cfg.check_quorum,
+            first_index=dev_first,
+            marker_term=marker_term,
+            last_index=dev_last,
+            committed=committed - b,
+            processed=max(snap_index - b, 0),
+            applied=max(snap_index - b, 0),
+            unsaved_from=1 if bootstrap else dev_last + 1,
+            log_term=ring_terms,
+            log_is_cc=ring_cc,
+            next=dev_last + 1,
+            quiesce_on=quiesce_on,
+            quiesce_threshold=quiesce_threshold,
         )
-        self._state = s._replace(**upd)
-        lane.active = True
+
+    # per-lane value keys forwarded into the jitted activation scatter
+    _ACT_COLS = (
+        ("self_slot", np.int32),
+        ("term", np.int32),
+        ("vote", np.int32),
+        ("role", np.int32),
+        ("election_timeout", np.int32),
+        ("heartbeat_timeout", np.int32),
+        ("rand_timeout", np.int32),
+        ("check_quorum", bool),
+        ("first_index", np.int32),
+        ("marker_term", np.int32),
+        ("last_index", np.int32),
+        ("committed", np.int32),
+        ("processed", np.int32),
+        ("applied", np.int32),
+        ("unsaved_from", np.int32),
+        ("next", np.int32),
+        ("quiesce_on", bool),
+        ("quiesce_threshold", np.int32),
+    )
+    _ACT_MATS = (
+        ("member", bool),
+        ("voting", bool),
+        ("observer", bool),
+        ("witness", bool),
+        ("log_term", np.int32),
+        ("log_is_cc", bool),
+    )
+
+    def _activate_batch(self, lanes: List[_Lane]) -> None:
+        """Activate many lanes with ONE jitted scatter call — the engine
+        analogue of ops/state.configure_groups_uniform. Batches pad to
+        power-of-4 buckets so the compile caches hit."""
+        vals: List[dict] = []
+        gs: List[int] = []
+        for lane in lanes:
+            try:
+                v = self._compute_activation(lane)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                continue
+            if v is not None:
+                vals.append(v)
+                gs.append(lane.g)
+                lane.active = True
+        if not vals:
+            return
+        n = len(vals)
+        bucket = 1
+        while bucket < n:
+            bucket *= 4
+        bucket = min(bucket, self.kcfg.groups)
+        pad = bucket - n
+        # padding repeats the last lane (duplicate scatter indexes with
+        # identical values are order-independent)
+        gi = np.asarray(gs + [gs[-1]] * pad, np.int32)
+        v = {}
+        for key, dtype in self._ACT_COLS:
+            a = np.asarray([x[key] for x in vals], dtype)
+            if pad:
+                a = np.concatenate([a, np.repeat(a[-1:], pad, 0)])
+            v[key] = jnp.asarray(a)
+        for key, dtype in self._ACT_MATS:
+            a = np.stack([x[key] for x in vals]).astype(dtype)
+            if pad:
+                a = np.concatenate([a, np.repeat(a[-1:], pad, 0)])
+            v[key] = jnp.asarray(a)
+        fn = _make_activate_fn(self.kcfg, bucket)
+        self._state = fn(self._state, jnp.asarray(gi), v)
         self._ready.set()
 
     def _logdb_state(self, node) -> State:
@@ -1432,7 +1978,13 @@ class VectorEngine:
         s = self._state
         self._state = s._replace(active=s.active.at[lane.g].set(False))
         lane.active = False
+        self._m_active[lane.g] = False
+        self._m_quiesced[lane.g] = False
+        self._carry.discard(lane)
+        self._catchups.discard(lane)
+        self._snapfb.discard(lane)
         with self._lanes_mu:
+            self._lane_by_g[lane.g] = None
             self._free.append(lane.g)
 
     def _reconcile_membership(self, node) -> None:
@@ -1445,9 +1997,16 @@ class VectorEngine:
         member_ids = set(mem.addresses) | set(mem.observers) | set(mem.witnesses)
         if not member_ids:
             return
+        sig = (
+            frozenset(member_ids),
+            frozenset(mem.observers),
+            frozenset(mem.witnesses),
+        )
+        if sig == lane.mem_sig:
+            return  # image unchanged (bootstrap CCs restate membership)
+        lane.mem_sig = sig
         P = self.kcfg.peers
         g = lane.g
-        old_rev = dict(lane.rev)
         perm = lane.set_slots(member_ids)
         s = self._state
         # permute [P]-indexed rows: value at old slot moves to new slot
@@ -1495,13 +2054,14 @@ class VectorEngine:
         self_slot = lane.self_slot()
         if self_slot < 0:
             self_slot = lane.slot_of(node.node_id(), provisional=True)
+        new_leader = remap_ref(s.leader[g])
         upd = dict(
             member=s.member.at[g].set(jnp.asarray(member)),
             voting=s.voting.at[g].set(jnp.asarray(voting)),
             observer=s.observer.at[g].set(jnp.asarray(observer)),
             witness=s.witness.at[g].set(jnp.asarray(witness)),
             self_slot=s.self_slot.at[g].set(max(self_slot, 0)),
-            leader=s.leader.at[g].set(remap_ref(s.leader[g])),
+            leader=s.leader.at[g].set(new_leader),
             vote=s.vote.at[g].set(remap_ref(s.vote[g])),
             transfer_to=s.transfer_to.at[g].set(remap_ref(s.transfer_to[g])),
             match=s.match.at[g].set(jnp.asarray(match)),
@@ -1516,12 +2076,20 @@ class VectorEngine:
             ri_acks=s.ri_acks.at[g].set(0),
         )
         self._state = s._replace(**upd)
-        # catchup/leader mirrors use slots: remap
-        lane.catchup = {
-            perm[p]: v for p, v in lane.catchup.items() if p in perm
+        self._m_leader[g] = new_leader
+        # catchup/snapshot-feedback mirrors use slots: remap
+        remapped = {}
+        for p, v in lane.catchup.items():
+            if p in perm:
+                remapped[perm[p]] = v
+        lane.catchup = remapped
+        if not lane.catchup:
+            self._catchups.discard(lane)
+        lane.snap_inflight = {
+            perm[p]: v for p, v in lane.snap_inflight.items() if p in perm
         }
-        if lane.leader_slot >= 0:
-            lane.leader_slot = perm.get(lane.leader_slot, -1)
+        if not lane.snap_inflight:
+            self._snapfb.discard(lane)
 
     def _reconcile_restore(self, node, ss: Snapshot) -> None:
         """An InstallSnapshot finished recovering: rebuild the lane at the
@@ -1535,12 +2103,16 @@ class VectorEngine:
         mem = ss.membership or node.sm.get_membership()
         member_ids = set(mem.addresses) | set(mem.observers) | set(mem.witnesses)
         lane.set_slots(member_ids)
-        lane.base = ss.index
-        lane.first_index = 1
-        lane.committed = ss.index
-        lane.last_index = ss.index
+        lane.mem_sig = (
+            frozenset(member_ids),
+            frozenset(mem.observers),
+            frozenset(mem.witnesses),
+        )
         lane.arena = {}
         lane.catchup = {}
+        lane.snap_inflight = {}
+        self._catchups.discard(lane)
+        self._snapfb.discard(lane)
         member = np.zeros((P,), bool)
         voting = np.zeros((P,), bool)
         observer = np.zeros((P,), bool)
@@ -1560,7 +2132,11 @@ class VectorEngine:
         if self_slot < 0:
             self_slot = lane.slot_of(node.node_id(), provisional=True)
         s = self._state
-        term = max(int(np.asarray(s.term[g])), ss.term)
+        # the lane may carry the snapshot sender's (higher) term, adopted
+        # in _handle_install_snapshot; the restore ack must not be
+        # droppable as stale by the leader
+        term = max(int(np.asarray(s.term[g])), ss.term, lane.adopted_term)
+        lane.adopted_term = 0
         upd = dict(
             member=s.member.at[g].set(jnp.asarray(member)),
             voting=s.voting.at[g].set(jnp.asarray(voting)),
@@ -1587,6 +2163,13 @@ class VectorEngine:
             ri_count=s.ri_count.at[g].set(0),
         )
         self._state = s._replace(**upd)
+        # ---- numpy mirrors ------------------------------------------------
+        self._m_base[g] = ss.index
+        self._m_devfirst[g] = 1
+        self._m_term[g] = term
+        self._m_commit[g] = 0
+        self._m_last[g] = 0
+        self._m_quiesced[g] = False
         lane.recovering = False
         # persist the post-restore hard state and ack the leader so its
         # remote leaves the Snapshot state (raft.go handleInstallSnapshot)
@@ -1599,7 +2182,7 @@ class VectorEngine:
                 )
             ]
         )
-        leader = lane.rev.get(lane.leader_slot)
+        leader = lane.rev.get(int(self._m_leader[g]) - 1)
         sender = leader if leader and leader != node.node_id() else None
         if sender is None:
             # best effort: ack every voting peer; only the leader cares
@@ -1656,7 +2239,7 @@ class VectorEngine:
                     traceback.print_exc()
                 lane = self._lane_of(node)
                 if lane is not None:
-                    lane.snapshot_pending = False
+                    self._m_snap_pending[lane.g] = False
 
     # --------------------------------------------------------------- control
     def stop(self) -> None:

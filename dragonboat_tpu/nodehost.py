@@ -612,13 +612,19 @@ class NodeHost(IMessageHandler):
                 time.sleep(min(period, next_t - now))
                 continue
             # catch-up ticks are coalesced by the MessageQueue counter
+            # (scalar engine) or the engine-global tick counter (vector
+            # engine: one increment covers every lane, no per-node work)
+            global_tick = getattr(self.engine, "global_tick", None)
             while next_t <= now:
                 next_t += period
-                with self._nodes_mu:
-                    nodes = list(self._nodes.values())
-                for n in nodes:
-                    n.mq.add(Message(type=MessageType.LOCAL_TICK))
-                    self.engine.set_node_ready(n.cluster_id)
+                if global_tick is not None:
+                    global_tick()
+                else:
+                    with self._nodes_mu:
+                        nodes = list(self._nodes.values())
+                    for n in nodes:
+                        n.mq.add(Message(type=MessageType.LOCAL_TICK))
+                        self.engine.set_node_ready(n.cluster_id)
                 self._chunks.tick()  # abandoned inbound stream GC
 
 

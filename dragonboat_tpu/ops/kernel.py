@@ -680,11 +680,40 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
 # ---------------------------------------------------------------------------
 
 
+def _quiesce(s: RaftTensors, inbox: Inbox, ticks):
+    """Idle-lane freeze (cf. quiesce.go:23-123): a lane with quiesce
+    enabled that sees no non-heartbeat inbox traffic for quiesce_threshold
+    ticks enters the quiesced state; while quiesced its election/heartbeat
+    timers do not advance (so leaders stop heartbeating and followers stop
+    campaigning), making 10k+ idle groups cost zero host fan-out. Any
+    non-heartbeat message (Replicate, Propose, RequestVote, the engine's
+    wake NOOP) exits quiesce, with the election timer rewound so the exit
+    cannot itself trigger an election."""
+    t = inbox.mtype
+    activity = jnp.any(
+        (t != MSG.NONE) & (t != MSG.HEARTBEAT) & (t != MSG.HEARTBEAT_RESP),
+        axis=1,
+    )
+    idle = jnp.where(
+        activity | ~s.quiesce_on, 0, s.idle_ticks + jnp.maximum(ticks, 0)
+    )
+    entering = s.quiesce_on & s.active & ~s.quiesced & (
+        idle >= s.quiesce_threshold
+    )
+    exiting = s.quiesced & activity
+    return s._replace(
+        idle_ticks=idle,
+        quiesced=(s.quiesced | entering) & ~activity,
+        election_tick=jnp.where(exiting, 0, s.election_tick),
+    )
+
+
 def _tick(s: RaftTensors, ticks, out):
     """Advance logical clocks for lanes with ticks > 0 (cf. raft.go:551-629).
     Multiple coalesced ticks advance timers by that amount, matching the
-    reference's LocalTick coalescing (node.go:1152-1159)."""
-    do = s.active & (ticks > 0)
+    reference's LocalTick coalescing (node.go:1152-1159). Quiesced lanes
+    freeze (cf. quiescedTick raft.go:623-629)."""
+    do = s.active & (ticks > 0) & ~s.quiesced
     s = s._replace(
         tick_count=s.tick_count + jnp.where(do, ticks, 0),
         election_tick=s.election_tick + jnp.where(do, ticks, 0),
@@ -764,6 +793,7 @@ def step_batch(
         "force_probe": jnp.zeros((G, P), bool),
     }
 
+    s = _quiesce(s, inbox, ticks)
     s, out = _tick(s, ticks, out)
 
     # drain inbox via scan: iteration k applies slot k for every group
@@ -821,6 +851,18 @@ def step_batch(
     s = s._replace(committed=jnp.where(can_commit, qidx, s.committed))
 
     # ---- replication fan-out ----------------------------------------------
+    # invariant: a peer parked for a snapshot un-parks as soon as its match
+    # covers the snapshot watermark, regardless of WHICH message moved it
+    # (the restore ack can arrive as a ReplicateResp the host already
+    # folded, or the watermark can be lowered by the host reconciling the
+    # actually-sent snapshot index; cf. remote.go:145-153 respondedTo)
+    s = s._replace(
+        rstate=jnp.where(
+            (s.rstate == RSTATE.SNAPSHOT) & (s.match >= s.snap_sent),
+            RSTATE.RETRY,
+            s.rstate,
+        )
+    )
     # send to every lagging, unpaused peer; optimistically advance next for
     # peers in REPLICATE state (pipelining, remote.go progress())
     selfm = _self_mask(s)
@@ -984,6 +1026,7 @@ def step_batch(
         role=s.role,
         match=s.match,
         last_index=s.last_index,
+        quiesced=s.quiesced,
     )
     return s, output
 
@@ -992,7 +1035,7 @@ def _popcount(x):
     return jax.lax.population_count(x.astype(jnp.uint32)).astype(i32)
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=None)
 def make_step_fn(cfg: KernelConfig, donate: bool = True):
     """Return a jitted step(state, inbox, ticks) -> (state, output).
     Cached per (cfg, donate) so every engine/cluster with the same static

@@ -134,6 +134,12 @@ class RaftTensors(NamedTuple):
     transfer_flag: jax.Array  # bool[G] this node is a sanctioned transfer target
     # membership change guard
     pending_cc: jax.Array  # bool[G] uncommitted config change in flight
+    # quiesce (cf. quiesce.go:23-123): idle lanes freeze their timers and
+    # stop exchanging heartbeats; any non-heartbeat inbox message exits
+    quiesce_on: jax.Array  # bool[G] per-lane config enable
+    quiesce_threshold: jax.Array  # i32[G] idle ticks before entering
+    quiesced: jax.Array  # bool[G]
+    idle_ticks: jax.Array  # i32[G] ticks since last non-heartbeat activity
     # read index queue (FIFO of R slots, ctx 0 = empty)
     ri_ctx: jax.Array  # i32[G,R]
     ri_index: jax.Array  # i32[G,R]
@@ -214,6 +220,8 @@ class StepOutput(NamedTuple):
     role: jax.Array  # i32[G] ROLE.*
     match: jax.Array  # i32[G,P]
     last_index: jax.Array  # i32[G]
+    quiesced: jax.Array  # bool[G] lane idle-frozen (host packs a wake NOOP
+    #   before staging work for a quiesced lane)
 
 
 def init_state(cfg: KernelConfig) -> RaftTensors:
@@ -262,6 +270,10 @@ def init_state(cfg: KernelConfig) -> RaftTensors:
         transfer_to=z_g(),
         transfer_flag=f_g(),
         pending_cc=f_g(),
+        quiesce_on=f_g(),
+        quiesce_threshold=jnp.full((G,), 100, i32),
+        quiesced=f_g(),
+        idle_ticks=z_g(),
         ri_ctx=jnp.zeros((G, R), i32),
         ri_index=jnp.zeros((G, R), i32),
         ri_acks=jnp.zeros((G, R), i32),
@@ -388,6 +400,14 @@ def configure_groups_uniform(
         rand_timeout=jnp.asarray(rand_to),
         check_quorum=jnp.full((G,), check_quorum, bool),
     )
+
+
+def lane_seed(g: int) -> int:
+    """Host-side replica of init_state's per-lane PRNG seed. The kernel
+    reads but never writes the seed tensor, so this stays a pure function
+    of the lane index — the engine uses it to compute randomized election
+    timeouts during bulk activation without a device round-trip."""
+    return ((g + 1) * 2654435761) & 0xFFFFFFFF
 
 
 def _mix(a, b, c):

@@ -239,6 +239,9 @@ class PendingProposal:
         for rs in states:
             rs.notify(RequestResult(code=REQUEST_TIMEOUT))
 
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
 
 class PendingReadIndex:
     """ReadIndex batching: many user reads share one system context
@@ -268,6 +271,14 @@ class PendingReadIndex:
 
     def has_queued(self) -> bool:
         return bool(self._queued)
+
+    def has_pending(self) -> bool:
+        return bool(self._queued or self._batches)
+
+    def has_ctx(self, ctx: SystemCtx) -> bool:
+        """Whether a bound batch is still alive for ctx (engine-side
+        routing entries are GC'd once their batch times out or completes)."""
+        return ctx in self._batches
 
     def next_ctx(self) -> SystemCtx:
         return SystemCtx(
@@ -422,6 +433,9 @@ class _SingleSlotPending:
             self._pending = None
         rs.notify(RequestResult(code=REQUEST_TIMEOUT))
 
+    def has_pending(self) -> bool:
+        return self._pending is not None
+
 
 class PendingConfigChange(_SingleSlotPending):
     def request(
@@ -478,6 +492,9 @@ class PendingLeaderTransfer:
             t = self._target
             self._target = None
             return t
+
+    def peek(self) -> bool:
+        return self._target is not None
 
 
 __all__ = [

@@ -35,13 +35,13 @@ int main(int argc, char** argv) {
 
   const char* members = "{\"1\":\"127.0.0.1:27847\"}";
   const char* ccfg =
-      "{\"cluster_id\":7,\"node_id\":1,\"election_rtt\":10,"
+      "{\"cluster_id\":7,\"node_id\":1,\"election_rtt\":20,"
       "\"heartbeat_rtt\":2}";
   if (dbtpu_start_cluster(nh, members, 0, argv[2], ccfg, err, sizeof(err)))
     return fail("start_cluster", err);
 
   // wait for self-election
-  for (int i = 0; i < 400; i++) {
+  for (int i = 0; i < 1500; i++) {
     uint64_t lid = 0;
     int has = 0;
     if (dbtpu_get_leader_id(nh, 7, &lid, &has, err, sizeof(err)) == 0 &&

@@ -72,8 +72,11 @@ def _mk_host(nid, reg, tmp, engine_kind="scalar"):
     members = {h: f"c{h}:1" for h in HOSTS}
     nh.start_cluster(
         members, False, lambda c, n: HashKV(),
+        # election timeout must comfortably exceed the in-process 3-engine
+        # message RTT even on a loaded CI machine, or elections split-vote
+        # through the whole chaos window (cf. config.go RTT guidance)
         Config(
-            cluster_id=CLUSTER, node_id=nid, election_rtt=10, heartbeat_rtt=2,
+            cluster_id=CLUSTER, node_id=nid, election_rtt=20, heartbeat_rtt=4,
             snapshot_entries=50, compaction_overhead=10,
         ),
     )
@@ -155,7 +158,7 @@ def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
         t.start()
 
     # -------- fault injection: drops, partitions, kill+restart ------------
-    t_end = time.time() + 12
+    t_end = time.time() + 20
     while time.time() < t_end:
         fault = rng.choice(["partition", "drop", "restart", "none"])
         victim = rng.choice(HOSTS)

@@ -124,7 +124,7 @@ def test_cpp_sm_cluster_end_to_end(tmp_path):
         nh.start_cluster(
             {} if restart else {1: "q1:1", 2: "q2:1", 3: "q3:1"},
             False, factory,
-            Config(cluster_id=1, node_id=nid, election_rtt=10,
+            Config(cluster_id=1, node_id=nid, election_rtt=20,
                    heartbeat_rtt=2, snapshot_entries=30,
                    compaction_overhead=5),
         )
@@ -134,7 +134,8 @@ def test_cpp_sm_cluster_end_to_end(tmp_path):
         hosts[nid] = mk(nid)
 
     leader = None
-    deadline = time.time() + 20
+    # generous: the first user of this engine shape pays the jit compile
+    deadline = time.time() + 60
     while time.time() < deadline and leader is None:
         for nid, nh in hosts.items():
             lid, ok = nh.get_leader_id(1)

@@ -79,7 +79,7 @@ def test_import_snapshot_quorum_repair(tmp_path):
         nh.start_cluster(
             members, False, lambda c, n: KV(),
             Config(cluster_id=CLUSTER, node_id=nid,
-                   election_rtt=10, heartbeat_rtt=2),
+                   election_rtt=20, heartbeat_rtt=4),
         )
         hosts[nid] = nh
     leader = _wait_leader(hosts)
@@ -114,7 +114,7 @@ def test_import_snapshot_quorum_repair(tmp_path):
     nh1.start_cluster(
         {}, False, lambda c, n: KV(),
         Config(cluster_id=CLUSTER, node_id=1,
-               election_rtt=10, heartbeat_rtt=2),
+               election_rtt=20, heartbeat_rtt=4),
     )
     deadline = time.time() + 20
     while time.time() < deadline:
@@ -158,7 +158,7 @@ def test_export_does_not_compact_own_history(tmp_path):
     nh = NodeHost(_nh_config(1, str(tmp_path), reg))
     nh.start_cluster(
         {1: "t1:1"}, False, lambda c, n: KV(),
-        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
                heartbeat_rtt=2, compaction_overhead=3),
     )
     _wait_leader({1: nh})
@@ -173,7 +173,7 @@ def test_export_does_not_compact_own_history(tmp_path):
     nh2 = NodeHost(_nh_config(1, str(tmp_path), reg))
     nh2.start_cluster(
         {}, False, lambda c, n: KV(),
-        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
                heartbeat_rtt=2, compaction_overhead=3),
     )
     deadline = time.time() + 20
@@ -196,7 +196,7 @@ def test_request_snapshot_bad_export_path(tmp_path):
     nh = NodeHost(_nh_config(1, str(tmp_path), reg))
     nh.start_cluster(
         {1: "t1:1"}, False, lambda c, n: KV(),
-        Config(cluster_id=CLUSTER, node_id=1, election_rtt=10,
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
                heartbeat_rtt=2),
     )
     try:
