@@ -159,10 +159,11 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
     members = {1: "bench:1", 2: "bench:2", 3: "bench:3"}
     hosts = {}
     # timers: the election timeout must comfortably exceed the in-process
-    # 3-engine message RTT (pack->step->decode->transport->peer step->ack,
-    # ~10-30ms under load) or elections split-vote forever — the same
-    # config rule the reference documents for its RTT-derived timeouts
-    # (config.go:60-126). 10ms ticks x 20 election RTT = 200-400ms.
+    # 3-engine message RTT AND the worst-case GIL starvation of an engine
+    # loop while the submitter thread bursts a wave, or heartbeat gaps
+    # trigger spurious elections mid-bench — the same config rule the
+    # reference documents for its RTT-derived timeouts (config.go:60-126).
+    # 10ms ticks x 100 election RTT = 1-2s timeouts, 200ms heartbeats.
     for nid, addr in members.items():
         cfg = NodeHostConfig(
             raft_address=addr,
@@ -184,8 +185,8 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
                 False,
                 lambda cid, nid_: sm_cls(cid, nid_),
                 Config(
-                    node_id=nid, cluster_id=c, election_rtt=20,
-                    heartbeat_rtt=4,
+                    node_id=nid, cluster_id=c, election_rtt=100,
+                    heartbeat_rtt=20,
                 ),
             )
     # wait for every group to elect a leader
@@ -213,20 +214,50 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
     }
     # pipelined waves: WAVE proposals per group in flight, wait, repeat
     # (32 = 4 full inbox rows of 8 entries per lane per step; commits for
-    # the whole wave ride one quorum round, amortizing the step latency)
+    # the whole wave ride one quorum round, amortizing the step latency).
+    # Pacing waits only on each group's LAST proposal — a straggler lost
+    # to leadership churn must not serialize the wave behind its timeout;
+    # completions are counted non-blocking at the end of the next wave.
     WAVE = 32
     total = 0
+    pending_count: list = []
     t0 = time.perf_counter()
     deadline = t0 + duration_s
     while time.perf_counter() < deadline:
         outstanding = []
+        last_per_group = []
         for c, sess in sessions.items():
             nh = hosts[leaders[c]]
+            rs = None
             for _ in range(WAVE):
-                outstanding.append(nh.propose(sess, cmd, 60))
-        for rs in outstanding:
-            rs.wait(timeout=60)
-        total += sum(1 for rs in outstanding if rs.result and rs.result.completed)
+                rs = nh.propose(sess, cmd, 30)
+                outstanding.append(rs)
+            last_per_group.append(rs)
+        for rs in last_per_group:
+            rs.wait(timeout=5)
+        done = 0
+        still = []
+        for rs in outstanding:  # one pass: a result landing between two
+            r = rs.result       # scans must not vanish from both buckets
+            if r is not None and r.completed:
+                done += 1
+            elif r is None:
+                still.append(rs)
+        total += done
+        pending_count.append(still)
+        # refresh leadership for the next wave (churn under load moves it)
+        for c in sessions:
+            lid, ok = hosts[1].get_leader_id(c)
+            if ok:
+                leaders[c] = lid
+    # late completions from the last waves
+    t_settle = time.perf_counter()
+    for batch in pending_count:
+        for rs in batch:
+            if rs.result is None and time.perf_counter() - t_settle < 10:
+                rs.wait(timeout=0.2)
+            if rs.result and rs.result.completed:
+                total += 1
     dt = time.perf_counter() - t0
     for nh in hosts.values():
         nh.stop()

@@ -21,6 +21,7 @@ from ..logger import get_logger
 from ..settings import hard, soft
 from ..trace import Profiler
 from ..types import Update
+from ..rsm.manager import From as OffloadFrom
 from .node import Node
 
 _plog = get_logger("execengine")
@@ -243,12 +244,16 @@ class ExecEngine:
                 node = self.get_node(cid)
                 if node is None or node.stopped:
                     continue
+                if not node.sm.loaded(OffloadFrom.COMMIT_WORKER):
+                    continue  # lost the race with NodeHost close
                 try:
                     node.handle_task(batch, apply)
                 except Exception:
                     import traceback
 
                     traceback.print_exc()
+                finally:
+                    node.sm.offloaded(OffloadFrom.COMMIT_WORKER)
                 if node.sm.task_queue.size() > 0:
                     self.set_task_ready(cid)
 
@@ -262,12 +267,16 @@ class ExecEngine:
                 node = self.get_node(cid)
                 if node is None or node.stopped:
                     continue
+                if not node.sm.loaded(OffloadFrom.SNAPSHOT_WORKER):
+                    continue  # lost the race with NodeHost close
                 try:
                     node.run_snapshot_work()
                 except Exception:
                     import traceback
 
                     traceback.print_exc()
+                finally:
+                    node.sm.offloaded(OffloadFrom.SNAPSHOT_WORKER)
 
     # --------------------------------------------------------------- control
     def stop(self) -> None:

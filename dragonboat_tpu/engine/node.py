@@ -28,6 +28,7 @@ from ..requests import (
     PendingSnapshot,
     RequestState,
 )
+from ..rsm.encoded import maybe_encode_entry
 from ..rsm import (
     SSRequest,
     SS_REQ_EXPORTED,
@@ -179,6 +180,10 @@ class Node:
         if len(cmd) > soft.max_proposal_payload_size:
             raise ErrPayloadTooBig()
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        # optional payload compression at the propose boundary: the wire,
+        # logdb and apply queue all carry the compressed form; replicas
+        # decompress once at apply time (cf. rsm/encoded.go:47-176)
+        maybe_encode_entry(self.config.entry_compression_type, entry)
         if not self.incoming_proposals.add(entry):
             self.pending_proposals.dropped(rs.key)
             raise ErrSystemBusy()

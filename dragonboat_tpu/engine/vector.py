@@ -80,6 +80,7 @@ from ..types import (
     SystemCtx,
     Update,
 )
+from ..rsm.manager import From as OffloadFrom
 from .execengine import WorkReady
 from .node import Node
 
@@ -2213,12 +2214,16 @@ class VectorEngine:
                 node = self.get_node(cid)
                 if node is None or node.stopped:
                     continue
+                if not node.sm.loaded(OffloadFrom.COMMIT_WORKER):
+                    continue  # lost the race with NodeHost close
                 try:
                     node.handle_task(batch, apply)
                 except Exception:
                     import traceback
 
                     traceback.print_exc()
+                finally:
+                    node.sm.offloaded(OffloadFrom.COMMIT_WORKER)
                 if node.sm.task_queue.size() > 0:
                     self.set_task_ready(cid)
 
@@ -2231,12 +2236,16 @@ class VectorEngine:
                 node = self.get_node(cid)
                 if node is None or node.stopped:
                     continue
+                if not node.sm.loaded(OffloadFrom.SNAPSHOT_WORKER):
+                    continue  # lost the race with NodeHost close
                 try:
                     node.run_snapshot_work()
                 except Exception:
                     import traceback
 
                     traceback.print_exc()
+                finally:
+                    node.sm.offloaded(OffloadFrom.SNAPSHOT_WORKER)
                 lane = self._lane_of(node)
                 if lane is not None:
                     self._m_snap_pending[lane.g] = False

@@ -64,6 +64,11 @@ class ErrDeadlineNotSet(RequestError):
     code = "deadline not set"
 
 
+class ErrDirLocked(RuntimeError):
+    """The nodehost dir is held by another live NodeHost
+    (cf. internal/server/context.go dir-lock files)."""
+
+
 class ClusterInfo:
     """cf. nodehost.go GetNodeHostInfo ClusterInfo."""
 
@@ -90,11 +95,13 @@ class NodeHost(IMessageHandler):
             enable_metrics=cfg.enable_metrics,
         )
         # --- directories
+        self._dir_lock_fd = None
         if cfg.nodehost_dir:
             self._dir = os.path.join(
                 cfg.nodehost_dir, cfg.raft_address.replace(":", "-")
             )
             os.makedirs(self._dir, exist_ok=True)
+            self._acquire_dir_lock()
             self._tmpdir = None
         else:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="dbtpu-")
@@ -141,6 +148,36 @@ class NodeHost(IMessageHandler):
         self._tick_thread.start()
         self._partitioned = False  # monkey-test knob
 
+    def _acquire_dir_lock(self) -> None:
+        """Exclusive advisory lock on the nodehost dir (cf. reference
+        internal/server/context.go:72-333 dir-lock files): a second process
+        or NodeHost opening the same dir would silently corrupt the WAL, so
+        it must fail fast instead."""
+        import fcntl
+
+        path = os.path.join(self._dir, "LOCK")
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise ErrDirLocked(
+                f"nodehost dir {self._dir} is locked by another NodeHost"
+            )
+        os.ftruncate(fd, 0)
+        os.write(fd, f"pid={os.getpid()} addr={self.config.raft_address}\n".encode())
+        self._dir_lock_fd = fd
+
+    def _release_dir_lock(self) -> None:
+        if self._dir_lock_fd is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._dir_lock_fd, fcntl.LOCK_UN)
+            finally:
+                os.close(self._dir_lock_fd)
+                self._dir_lock_fd = None
+
     # ------------------------------------------------------------ properties
     def raft_address(self) -> str:
         return self.config.raft_address
@@ -163,6 +200,7 @@ class NodeHost(IMessageHandler):
         self._event_aggregator.stop()
         if self._tick_thread.is_alive():
             self._tick_thread.join(timeout=2)
+        self._release_dir_lock()
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
 

@@ -1,0 +1,70 @@
+"""Entry payload encoding: optional compression of proposal payloads.
+
+Mirrors the reference's v0 header scheme (internal/rsm/encoded.go:47-176):
+an ENCODED entry's payload starts with one header byte
+`(version << 4) | compression_type`; plain APPLICATION entries carry raw
+bytes and are never touched. Compression happens once at propose time on
+the proposing replica and decompression once at apply time on every
+replica — the wire, the logdb, and the device-metadata path all carry the
+compressed bytes.
+
+The reference uses snappy; this build uses zlib (stdlib — no external
+deps are installable here) behind the same CompressionType seam. The
+header byte makes the format self-describing, so adding real snappy later
+is a new type value, not a migration.
+"""
+from __future__ import annotations
+
+import zlib
+
+from ..types import CompressionType, Entry, EntryType
+
+_V0 = 0
+
+
+def encode_payload(ct: CompressionType, data: bytes) -> bytes:
+    """Header byte + compressed body (cf. encoded.go newEncodedPayload)."""
+    if ct == CompressionType.NO_COMPRESSION:
+        return data
+    if ct == CompressionType.SNAPPY:
+        # zlib body behind the SNAPPY seam (see module docstring)
+        return bytes([(_V0 << 4) | int(ct)]) + zlib.compress(data, 1)
+    raise ValueError(f"unknown compression type {ct}")
+
+
+def decode_payload(e: Entry) -> bytes:
+    """Payload bytes for the state machine (cf. encoded.go GetPayload)."""
+    if e.type != EntryType.ENCODED:
+        return e.cmd
+    if not e.cmd:
+        raise ValueError("empty encoded payload")
+    hdr = e.cmd[0]
+    version = hdr >> 4
+    ct = hdr & 0x0F
+    if version != _V0:
+        raise ValueError(f"unknown encoded payload version {version}")
+    if ct == int(CompressionType.NO_COMPRESSION):
+        return e.cmd[1:]
+    if ct == int(CompressionType.SNAPPY):
+        return zlib.decompress(e.cmd[1:])
+    raise ValueError(f"unknown compression type {ct}")
+
+
+def maybe_encode_entry(ct: CompressionType, e: Entry) -> Entry:
+    """Compress a freshly proposed APPLICATION entry in place when the
+    group's config asks for it and it pays (tiny payloads skip)."""
+    if (
+        ct == CompressionType.NO_COMPRESSION
+        or e.type != EntryType.APPLICATION
+        or len(e.cmd) < 64
+    ):
+        return e
+    encoded = encode_payload(ct, e.cmd)
+    if len(encoded) >= len(e.cmd):
+        return e  # incompressible: keep plain
+    e.type = EntryType.ENCODED
+    e.cmd = encoded
+    return e
+
+
+__all__ = ["encode_payload", "decode_payload", "maybe_encode_entry"]

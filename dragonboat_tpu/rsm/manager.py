@@ -14,7 +14,53 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
+import enum
+
 from ..config import Config
+from .encoded import decode_payload
+
+
+class From(enum.IntEnum):
+    """Components that hold a reference to a managed SM
+    (cf. internal/rsm/offload.go:18-46)."""
+
+    STEP_WORKER = 0
+    COMMIT_WORKER = 1
+    SNAPSHOT_WORKER = 2
+    NODEHOST = 3
+
+
+class OffloadedStatus:
+    """Ref-counted destroy discipline (cf. offload.go:48-133): the SM dies
+    exactly once, after the NodeHost requests teardown and every worker
+    has released its reference."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._loaded: set = set()
+        self._teardown = False
+        self._destroyed = False
+
+    def set_loaded(self, frm: From) -> bool:
+        """False once teardown began: the caller lost the race with the
+        NodeHost close and must NOT touch the SM (the reference panics on
+        SetLoaded-after-destroyed; a skip is the non-fatal equivalent)."""
+        with self._mu:
+            if self._teardown or self._destroyed:
+                return False
+            self._loaded.add(frm)
+            return True
+
+    def set_offloaded(self, frm: From) -> bool:
+        """Returns True exactly once, when the destroy must run."""
+        with self._mu:
+            self._loaded.discard(frm)
+            if frm == From.NODEHOST:
+                self._teardown = True
+            if self._teardown and not self._loaded and not self._destroyed:
+                self._destroyed = True
+                return True
+            return False
 from ..statemachine import (
     SM_TYPE_ONDISK,
     AbortSignal,
@@ -194,6 +240,7 @@ class StateMachineManager:
         )
         self._snapshotting = False
         self._aborted = AbortSignal()
+        self._offload = OffloadedStatus()
         self.task_queue = TaskQueue()
         self._batched_last_applied = 0
         self._sync_req_index = 0
@@ -231,9 +278,21 @@ class StateMachineManager:
             self._index = idx
         return idx
 
-    def offloaded(self) -> None:
-        self._aborted.stop()
-        self._sm.destroy()
+    def loaded(self, frm: "From") -> bool:
+        """A component takes a reference to the managed SM; False when
+        teardown already began (cf. offload.go:48-133 SetLoaded)."""
+        return self._offload.set_loaded(frm)
+
+    def offloaded(self, frm: "From" = None) -> None:
+        """Drop a component's reference; the user SM is destroyed exactly
+        once, only after the NodeHost requested teardown AND every worker
+        released it — destroying under a mid-flight apply/snapshot would
+        hand the user a dead SM (cf. offload.go:48-133 SetOffloaded)."""
+        if frm is None or frm == From.NODEHOST:
+            frm = From.NODEHOST
+            self._aborted.stop()
+        if self._offload.set_offloaded(frm):
+            self._sm.destroy()
 
     # ------------------------------------------------------------ membership
     def get_membership(self) -> Membership:
@@ -422,7 +481,7 @@ class StateMachineManager:
                     self._set_applied(e.index, e.term)
                     self._node.apply_update(e, cached, False, False, True)
                     return
-        apply.append(SMEntry(index=e.index, cmd=e.cmd))
+        apply.append(SMEntry(index=e.index, cmd=decode_payload(e)))
         self._pending_session_entries = getattr(self, "_pending_session_entries", {})
         self._pending_session_entries[e.index] = e
 
@@ -517,9 +576,11 @@ class StateMachineManager:
     def _do_update(self, e: Entry, notify_read: bool, session: int = 0) -> None:
         skip = self._sm.on_disk() and e.index <= self._on_disk_init_index
         if skip:
-            results = [SMEntry(index=e.index, cmd=e.cmd)]
+            results = [SMEntry(index=e.index, cmd=decode_payload(e))]
         else:
-            results = self._sm.update([SMEntry(index=e.index, cmd=e.cmd)])
+            results = self._sm.update(
+                [SMEntry(index=e.index, cmd=decode_payload(e))]
+            )
         result = results[0].result if results else Result()
         with self._mu:
             if session:

@@ -28,7 +28,14 @@ from .kv import IKVStore, MemKV, WalKV, WriteBatch
 
 class _Shard:
     """One KV shard with the full key-schema CRUD
-    (cf. internal/logdb/rdb.go:47-52)."""
+    (cf. internal/logdb/rdb.go:47-52). Entries use the BATCHED layout
+    (cf. internal/logdb/batch.go:60-390): one key per fixed run of
+    consecutive indexes, so the engine's per-step save writes
+    O(entries/batch) kv records instead of O(entries), with a last-batch
+    cache avoiding the read-modify-write on the append hot path
+    (cf. rdbcache.go last-EntryBatch cache)."""
+
+    BATCH = hard.logdb_entry_batch_size
 
     def __init__(self, kv: IKVStore) -> None:
         self.kv = kv
@@ -36,6 +43,8 @@ class _Shard:
         # (cf. internal/logdb/rdbcache.go:24-116)
         self._state_cache = {}
         self._max_index_cache = {}
+        # (cid, nid) -> (batch_id, entries of that batch as last written)
+        self._batch_cache = {}
         self._mu = threading.Lock()
 
     # -- save path -----------------------------------------------------------
@@ -46,11 +55,39 @@ class _Shard:
         if wb.count() > 0:
             self.kv.commit_write_batch(wb)
 
+    def _save_entries(self, wb: WriteBatch, cid: int, nid: int, ents) -> None:
+        """Pack entries into batch records, merging the head batch with any
+        retained prefix (a rewrite from mid-batch keeps the entries below
+        the rewrite point, cf. batch.go:60-126 merge rules)."""
+        B = self.BATCH
+        first = ents[0].index
+        bid = first // B
+        cur: list = []
+        if first % B:
+            with self._mu:
+                cached = self._batch_cache.get((cid, nid))
+            if cached is not None and cached[0] == bid:
+                existing = cached[1]
+            else:
+                raw = self.kv.get_value(keys.batch_key(cid, nid, bid))
+                existing = codec.decode_entries(raw)[0] if raw else []
+            cur = [e for e in existing if e.index < first]
+        for e in ents:
+            b = e.index // B
+            if b != bid:
+                wb.put(
+                    keys.batch_key(cid, nid, bid), codec.encode_entries(cur)
+                )
+                bid, cur = b, []
+            cur.append(e)
+        wb.put(keys.batch_key(cid, nid, bid), codec.encode_entries(cur))
+        with self._mu:
+            self._batch_cache[(cid, nid)] = (bid, list(cur))
+
     def _record_update(self, wb: WriteBatch, ud: Update) -> None:
         cid, nid = ud.cluster_id, ud.node_id
-        for e in ud.entries_to_save:
-            wb.put(keys.entry_key(cid, nid, e.index), codec.encode_entry(e))
         if ud.entries_to_save:
+            self._save_entries(wb, cid, nid, ud.entries_to_save)
             last = ud.entries_to_save[-1].index
             self._set_max_index(wb, cid, nid, last)
         if ud.snapshot is not None and not ud.snapshot.is_empty():
@@ -93,35 +130,62 @@ class _Shard:
     def iterate_entries(
         self, cid: int, nid: int, low: int, high: int, max_size: int
     ) -> Tuple[List[Entry], int]:
-        fk, lk = keys.entry_range(cid, nid, low, high)
+        if high <= low:
+            return [], 0
+        B = self.BATCH
+        fk, lk = keys.batch_range(cid, nid, low // B, (high - 1) // B + 1)
         out: List[Entry] = []
         size = 0
         expected = low
 
         def visit(k: bytes, v: bytes) -> bool:
             nonlocal size, expected
-            e, _ = codec.decode_entry(v)
-            if e.index != expected:
-                return False  # hole: compacted below or beyond max
-            out.append(e)
-            expected += 1
-            size += len(e.cmd) + 48
-            return size <= max_size
+            batch, _ = codec.decode_entries(v)
+            for e in batch:
+                if e.index < expected or e.index >= high:
+                    continue  # boundary batch: entries outside the window
+                if e.index != expected:
+                    return False  # hole: compacted below or beyond max
+                out.append(e)
+                expected += 1
+                size += len(e.cmd) + 48
+                if size > max_size:
+                    return False
+            return True
 
         self.kv.iterate_value(fk, lk, False, visit)
         return out, size
 
     def remove_entries_to(self, cid: int, nid: int, index: int) -> None:
-        fk, lk = keys.entry_range(cid, nid, 0, index + 1)
+        B = self.BATCH
+        cut_bid = (index + 1) // B
+        fk, lk = keys.batch_range(cid, nid, 0, cut_bid)
         self.kv.bulk_remove_entries(fk, lk)
+        # the boundary batch straddles the cut: rewrite it with only the
+        # surviving tail so removed indexes never resurface through a
+        # direct iterate (the ILogDB contract; cf. batch.go:312-340)
+        bk = keys.batch_key(cid, nid, cut_bid)
+        raw = self.kv.get_value(bk)
+        if raw:
+            batch, _ = codec.decode_entries(raw)
+            keep = [e for e in batch if e.index > index]
+            if len(keep) != len(batch):
+                if keep:
+                    self.kv.put_value(bk, codec.encode_entries(keep))
+                else:
+                    self.kv.delete_value(bk)
+                with self._mu:
+                    cached = self._batch_cache.get((cid, nid))
+                    if cached is not None and cached[0] == cut_bid:
+                        self._batch_cache[(cid, nid)] = (cut_bid, keep)
 
     def compact_entries_to(self, cid: int, nid: int, index: int) -> None:
-        fk, lk = keys.entry_range(cid, nid, 0, index + 1)
+        fk, lk = keys.batch_range(cid, nid, 0, (index + 1) // self.BATCH)
         self.kv.compact_entries(fk, lk)
 
     def remove_node_data(self, cid: int, nid: int) -> None:
         wb = WriteBatch()
-        fk, lk = keys.entry_range(cid, nid, 0, 2**63)
+        fk, lk = keys.batch_range(cid, nid, 0, 2**62)
         wb.delete_range(fk, lk)
         sfk, slk = keys.snapshot_range(cid, nid, 0, 2**63)
         wb.delete_range(sfk, slk)
@@ -132,6 +196,7 @@ class _Shard:
         with self._mu:
             self._state_cache.pop((cid, nid), None)
             self._max_index_cache.pop((cid, nid), None)
+            self._batch_cache.pop((cid, nid), None)
 
 
 class ShardedLogDB(ILogDB):
@@ -218,13 +283,18 @@ class ShardedLogDB(ILogDB):
             return snapshot_index, 0
         low = snapshot_index + 1
         first = None
+        B = sh.BATCH
 
         def visit(k: bytes, v: bytes) -> bool:
             nonlocal first
-            first = keys.entry_index(k)
-            return False
+            batch, _ = codec.decode_entries(v)
+            for e in batch:
+                if e.index >= low:
+                    first = e.index
+                    return False
+            return True
 
-        fk, lk = keys.entry_range(cid, nid, low, 2**63)
+        fk, lk = keys.batch_range(cid, nid, low // B, 2**62)
         sh.kv.iterate_value(fk, lk, False, visit)
         if first is None or max_index < first:
             return snapshot_index, 0
@@ -281,7 +351,7 @@ class ShardedLogDB(ILogDB):
         wb = WriteBatch()
         fk, lk = keys.snapshot_range(cid, node_id, 0, 2**63)
         wb.delete_range(fk, lk)
-        efk, elk = keys.entry_range(cid, node_id, 0, 2**63)
+        efk, elk = keys.batch_range(cid, node_id, 0, 2**62)
         wb.delete_range(efk, elk)
         bootstrap = Bootstrap(join=True, type=ss.type)
         wb.put(

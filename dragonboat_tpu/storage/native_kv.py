@@ -96,6 +96,10 @@ def _load() -> ctypes.CDLL:
     lib.walkv_maybe_compact.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.walkv_count.restype = ctypes.c_uint64
     lib.walkv_count.argtypes = [ctypes.c_void_p]
+    lib.walkv_roll_segment.restype = ctypes.c_int
+    lib.walkv_roll_segment.argtypes = [ctypes.c_void_p]
+    lib.walkv_segment_count.restype = ctypes.c_uint64
+    lib.walkv_segment_count.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -194,9 +198,8 @@ class NativeWalKV(IKVStore):
             raise OSError(f"walkv_bulk_remove failed: rc={rc}")
 
     def compact_entries(self, fk: bytes, lk: bytes) -> None:
-        rc = self._lib.walkv_maybe_compact(self._h, _COMPACT_THRESHOLD)
-        if rc != 0:
-            raise OSError(f"walkv_maybe_compact failed: rc={rc}")
+        # range args unused: compaction is store-wide and threshold-gated
+        self.maybe_compact()
 
     def full_compaction(self) -> None:
         rc = self._lib.walkv_full_compaction(self._h)
@@ -205,6 +208,20 @@ class NativeWalKV(IKVStore):
 
     def count(self) -> int:
         return int(self._lib.walkv_count(self._h))
+
+    def maybe_compact(self, threshold: int = _COMPACT_THRESHOLD) -> None:
+        rc = self._lib.walkv_maybe_compact(self._h, threshold)
+        if rc != 0:
+            raise OSError(f"walkv_maybe_compact failed: rc={rc}")
+
+    def roll_segment(self) -> None:
+        """Seal the active WAL as an immutable segment (O(1) rename)."""
+        rc = self._lib.walkv_roll_segment(self._h)
+        if rc != 0:
+            raise OSError(f"walkv_roll_segment failed: rc={rc}")
+
+    def segment_count(self) -> int:
+        return int(self._lib.walkv_segment_count(self._h))
 
 
 __all__ = ["NativeWalKV", "native_available", "NativeBuildError"]

@@ -118,6 +118,8 @@ class EntryType(enum.IntEnum):
     CONFIG_CHANGE = 1
     # Witness replicas receive metadata-only entries (cf. raft.go:742-756).
     METADATA = 2
+    # Payload carries the v0 compression header (cf. rsm/encoded.go:47-176).
+    ENCODED = 3
 
 
 class ConfigChangeType(enum.IntEnum):

@@ -1,0 +1,91 @@
+"""Micro-benchmarks for the host runtime hot paths.
+
+The analogue of the reference's benchmark_test.go: SaveRaftState at
+16/128/1024-byte payloads (benchmark_test.go:346-356), fsync latency
+(benchmark_test.go:271), and entry codec throughput. Pure host-side — no
+jax. Prints one JSON line per bench.
+
+Run: python microbench.py [--no-fsync]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import time
+
+from dragonboat_tpu import codec
+from dragonboat_tpu.storage.logdb import ShardedLogDB
+from dragonboat_tpu.types import Entry, State, Update
+
+
+def bench_save_raft_state(payload: int, fsync: bool, seconds: float = 2.0):
+    """One 8-entry update per group per save call, 16 groups per batch —
+    the shape of the engine's per-step batched save."""
+    with tempfile.TemporaryDirectory(prefix="mb-") as d:
+        db = ShardedLogDB(d, fsync=fsync)
+        idx = {c: 0 for c in range(1, 17)}
+        total_entries = 0
+        t0 = time.perf_counter()
+        deadline = t0 + seconds
+        while time.perf_counter() < deadline:
+            updates = []
+            for c in range(1, 17):
+                ents = [
+                    Entry(index=idx[c] + 1 + i, term=1, cmd=b"x" * payload)
+                    for i in range(8)
+                ]
+                idx[c] += 8
+                updates.append(
+                    Update(
+                        cluster_id=c, node_id=1,
+                        state=State(term=1, commit=idx[c]),
+                        entries_to_save=ents,
+                    )
+                )
+                total_entries += 8
+            db.save_raft_state(updates)
+        dt = time.perf_counter() - t0
+        db.close()
+        return {
+            "metric": f"save_raft_state_{payload}B",
+            "value": round(total_entries / dt, 1),
+            "unit": "entries/s",
+            "fsync": fsync,
+        }
+
+
+def bench_entry_codec(payload: int = 128, n: int = 200_000):
+    e = Entry(index=7, term=3, key=123456, client_id=42, series_id=9,
+              cmd=b"y" * payload)
+    data = codec.encode_entry(e)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        codec.encode_entry(e)
+    t_enc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        codec.decode_entry(data)
+    t_dec = time.perf_counter() - t0
+    return {
+        "metric": "entry_codec",
+        "encode_per_sec": round(n / t_enc, 1),
+        "decode_per_sec": round(n / t_dec, 1),
+        "payload": payload,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-fsync", action="store_true")
+    ap.add_argument("--seconds", type=float, default=2.0)
+    args = ap.parse_args()
+    for payload in (16, 128, 1024):
+        print(json.dumps(
+            bench_save_raft_state(payload, not args.no_fsync, args.seconds)
+        ))
+    print(json.dumps(bench_entry_codec()))
+
+
+if __name__ == "__main__":
+    main()

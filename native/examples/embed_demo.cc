@@ -41,7 +41,7 @@ int main(int argc, char** argv) {
     return fail("start_cluster", err);
 
   // wait for self-election
-  for (int i = 0; i < 1500; i++) {
+  for (int i = 0; i < 3000; i++) {
     uint64_t lid = 0;
     int has = 0;
     if (dbtpu_get_leader_id(nh, 7, &lid, &has, err, sizeof(err)) == 0 &&

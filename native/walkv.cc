@@ -18,7 +18,16 @@
 // C ABI (ctypes-friendly): every call crosses the FFI once per *batch* or
 // per *range*, never per key — the Python side serializes a whole write
 // batch into one blob and the iterator returns one serialized result blob.
+//
+// Compaction is SEGMENTED (the round-2 store rewrote the entire table on
+// every compaction, O(total live data) per churn cycle — unusable at
+// 10k-group scale; cf. the reference's LSM backends): the active WAL is
+// sealed into an immutable segment by a RENAME (O(1)), and only when the
+// segment count crosses a bound is the OLDEST half merged into one
+// compacted segment (O(live data of that tier), amortized). Replay applies
+// table.log (legacy), then seg-*.log in sequence order, then wal.log.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -74,6 +83,8 @@ class WalKV {
       return "cannot create dir " + dir_;
     }
     Replay(dir_ + "/table.log");
+    ScanSegments();
+    for (uint64_t s : segments_) Replay(SegPath(s));
     Replay(dir_ + "/wal.log");
     fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_APPEND,
                  0644);
@@ -191,6 +202,11 @@ class WalKV {
     }
     ::close(tfd);
     if (::rename(tmp.c_str(), (dir_ + "/table.log").c_str()) != 0) return -4;
+    // table.log now holds the FULL live state and replays first: stale
+    // segments must not re-apply over it
+    for (uint64_t s : segments_) ::unlink(SegPath(s).c_str());
+    segments_.clear();
+    FsyncDir();
     if (fd_ >= 0) ::close(fd_);
     fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_TRUNC,
                  0644);
@@ -203,12 +219,23 @@ class WalKV {
     return 0;
   }
 
+  // Seal the active WAL as an immutable segment: ONE rename + dir fsync,
+  // O(1) regardless of table size. Readers are unaffected (the in-memory
+  // table already holds every applied op).
+  int RollSegment() {
+    std::lock_guard<std::mutex> g(mu_);
+    return RollSegmentLocked();
+  }
+
   int MaybeCompact(uint64_t threshold) {
-    {
-      std::lock_guard<std::mutex> g(mu_);
-      if (pending_compact_ < threshold) return 0;
+    std::lock_guard<std::mutex> g(mu_);
+    if (pending_compact_ < threshold) return 0;
+    int rc = RollSegmentLocked();
+    if (rc != 0) return rc;
+    if (segments_.size() > kMaxSegments) {
+      return MergeOldestLocked(segments_.size() / 2);
     }
-    return FullCompaction();
+    return 0;
   }
 
   uint64_t Count() {
@@ -216,7 +243,137 @@ class WalKV {
     return table_.size();
   }
 
+  uint64_t SegmentCount() {
+    std::lock_guard<std::mutex> g(mu_);
+    return segments_.size();
+  }
+
  private:
+  static constexpr size_t kMaxSegments = 8;
+
+  std::string SegPath(uint64_t seq) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "/seg-%012llu.log",
+                  static_cast<unsigned long long>(seq));
+    return dir_ + buf;
+  }
+
+  void ScanSegments() {
+    segments_.clear();
+    DIR* d = ::opendir(dir_.c_str());
+    if (!d) return;
+    while (struct dirent* ent = ::readdir(d)) {
+      unsigned long long seq = 0;
+      int consumed = 0;
+      // %n guards against trailing garbage: "seg-...log.tmp" must NOT
+      // register (a crashed merge leaves tmps; clean them instead)
+      if (std::sscanf(ent->d_name, "seg-%12llu.log%n", &seq, &consumed) ==
+              1 &&
+          ent->d_name[consumed] == '\0') {
+        segments_.push_back(seq);
+        if (seq >= next_seg_) next_seg_ = seq + 1;
+      } else if (std::strstr(ent->d_name, ".tmp") != nullptr &&
+                 std::strncmp(ent->d_name, "seg-", 4) == 0) {
+        ::unlink((dir_ + "/" + ent->d_name).c_str());
+      }
+    }
+    ::closedir(d);
+    std::sort(segments_.begin(), segments_.end());
+  }
+
+  int FsyncDir() {
+    int dfd = ::open(dir_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd < 0) return -1;
+    int rc = ::fsync(dfd);
+    ::close(dfd);
+    return rc;
+  }
+
+  int RollSegmentLocked() {
+    if (failed_) return -10;
+    off_t sz = ::lseek(fd_, 0, SEEK_END);
+    if (sz <= 0) {
+      pending_compact_ = 0;
+      return 0;  // empty WAL: nothing to seal
+    }
+    uint64_t seq = next_seg_++;
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    if (::rename((dir_ + "/wal.log").c_str(), SegPath(seq).c_str()) != 0) {
+      fd_ = ::open((dir_ + "/wal.log").c_str(),
+                   O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (fd_ < 0) failed_ = true;
+      return -1;
+    }
+    if (FsyncDir() != 0) {
+      // the sealed segment exists; reopen a fresh WAL so fd_ never holds
+      // a dead descriptor, and poison the store if that fails too
+      segments_.push_back(seq);
+      fd_ = ::open((dir_ + "/wal.log").c_str(),
+                   O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (fd_ < 0) failed_ = true;
+      return -2;
+    }
+    segments_.push_back(seq);
+    fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                 0644);
+    if (fd_ < 0) return -3;
+    pending_compact_ = 0;
+    return 0;
+  }
+
+  // Merge the OLDEST n segments (plus the legacy table.log if present)
+  // into one compacted segment holding only their live state. Deletions
+  // recorded in NEWER segments re-apply during replay, so merging a
+  // prefix of the history is semantically a no-op. Cost is bounded by the
+  // live data of the merged tier, not the whole store.
+  int MergeOldestLocked(size_t n) {
+    if (n < 2 || n > segments_.size()) return 0;
+    WalKV tier("", false);
+    tier.Replay(dir_ + "/table.log");
+    for (size_t i = 0; i < n; ++i) tier.Replay(SegPath(segments_[i]));
+    uint64_t seq = next_seg_++;
+    std::string tmp = SegPath(seq) + ".tmp";
+    int tfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (tfd < 0) return -1;
+    std::string buf;
+    for (const auto& kv : tier.table_) {
+      Op o{OP_PUT, kv.first, kv.second};
+      AppendRec(buf, o);
+      if (buf.size() > (1u << 20)) {
+        if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
+          ::close(tfd);
+          return -2;
+        }
+        buf.clear();
+      }
+    }
+    if (WriteAll(tfd, buf.data(), buf.size()) != 0 || ::fsync(tfd) != 0) {
+      ::close(tfd);
+      return -3;
+    }
+    ::close(tfd);
+    // The merged tier becomes the new table.log — the FIRST replay layer.
+    // Crash-ordering argument: after the atomic rename, table.log holds
+    // exactly the state of (old table.log + merged segments); replaying
+    // the not-yet-unlinked input segments over it is IDEMPOTENT (their
+    // ops are re-applied onto the state that already includes them), and
+    // newer segments/wal replay after as always. A tombstone-free merge
+    // output may only ever replace the first layer — anywhere later it
+    // would resurrect keys that older layers still carry.
+    if (::rename(tmp.c_str(), (dir_ + "/table.log").c_str()) != 0)
+      return -4;
+    if (FsyncDir() != 0) return -5;
+    for (size_t i = 0; i < n; ++i) ::unlink(SegPath(segments_[i]).c_str());
+    FsyncDir();
+    std::vector<uint64_t> kept;
+    for (size_t i = n; i < segments_.size(); ++i)
+      kept.push_back(segments_[i]);
+    segments_ = std::move(kept);
+    // seq from next_seg_ was burned for the tmp name only; harmless
+    return 0;
+  }
+
   // Append + fsync as one durable unit. On any failure the file is
   // truncated back to its pre-write length: a torn record left in place
   // would otherwise make Replay() stop at it and silently discard every
@@ -327,6 +484,8 @@ class WalKV {
   bool failed_ = false;  // torn tail could not be truncated away
   int fd_ = -1;
   std::map<std::string, std::string> table_;
+  std::vector<uint64_t> segments_;  // sealed segment sequence numbers
+  uint64_t next_seg_ = 1;
   uint64_t pending_compact_ = 0;
   std::mutex mu_;
 };
@@ -395,6 +554,14 @@ int walkv_full_compaction(void* h) {
 
 int walkv_maybe_compact(void* h, uint64_t threshold) {
   return static_cast<WalKV*>(h)->MaybeCompact(threshold);
+}
+
+int walkv_roll_segment(void* h) {
+  return static_cast<WalKV*>(h)->RollSegment();
+}
+
+uint64_t walkv_segment_count(void* h) {
+  return static_cast<WalKV*>(h)->SegmentCount();
 }
 
 uint64_t walkv_count(void* h) { return static_cast<WalKV*>(h)->Count(); }

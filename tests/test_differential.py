@@ -27,9 +27,15 @@ HEARTBEAT = 2
 
 class ScalarCluster:
     """Scalar oracle wired to the kernel's timeout derivation and driven
-    with the same round structure as LoopbackCluster."""
+    with the same round structure as LoopbackCluster. Supports the same
+    link-level fault injection so randomized traces stay comparable."""
 
     def __init__(self, seed_of_group, g: int = 0):
+        self.dropped_links: set = set()  # (from_slot, to_slot)
+        self.isolated: set = set()  # slots
+        self._init_rafts(seed_of_group, g)
+
+    def _init_rafts(self, seed_of_group, g: int = 0):
         self.rafts = {}
         seed = seed_of_group
         for nid in range(1, N + 1):
@@ -59,6 +65,14 @@ class ScalarCluster:
         for r in self.rafts.values():
             r.tick()
 
+    def _deliverable(self, m) -> bool:
+        f, t = m.from_ - 1, m.to - 1  # slots
+        if (f, t) in self.dropped_links:
+            return False
+        if f in self.isolated or t in self.isolated:
+            return False
+        return True
+
     def settle(self, rounds=20):
         for _ in range(rounds):
             msgs = []
@@ -68,7 +82,7 @@ class ScalarCluster:
             if not msgs:
                 return
             for m in msgs:
-                if m.to in self.rafts:
+                if m.to in self.rafts and self._deliverable(m):
                     self.rafts[m.to].handle(m)
 
     def propose(self, nid, n=1):
@@ -155,6 +169,125 @@ def test_differential_election_and_replication(clusters):
     assert hi >= 8
     for h in range(N):
         assert kc.ring_terms(h, 0, 1, hi) == sc.log_terms(h + 1, 1, hi)
+
+
+def _compare_group(kc, scs, g, tag):
+    ko = []
+    for h in range(N):
+        st = kc.states[h]
+        ko.append(
+            {
+                "role": int(np.asarray(st.role)[g]),
+                "term": int(np.asarray(st.term)[g]),
+                "leader": int(np.asarray(st.leader)[g]) - 1,
+                "committed": int(np.asarray(st.committed)[g]),
+                "last": int(np.asarray(st.last_index)[g]),
+            }
+        )
+    so = scs[g].observables()
+    assert ko == so, f"{tag} g={g}: kernel={ko} scalar={so}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [3, 17])
+def test_differential_randomized_faults(seed):
+    """Randomized trace differ (round-3): thousands of seeded group-rounds
+    with link drops, replica isolation (partitions), proposals and leader
+    transfers — the kernel must track the scalar oracle observable-for-
+    observable through every fault schedule. 16 groups x 350 rounds x 2
+    seeds = 11,200 randomized group-trajectory rounds."""
+    import random
+
+    G, ROUNDS = 16, 350
+    rng = random.Random(seed)
+    kc = LoopbackCluster(
+        n_replicas=N, n_groups=G, election=ELECTION, heartbeat=HEARTBEAT,
+        seed=seed,
+    )
+    seeds = np.asarray(kc.states[0].seed)
+    scs = [ScalarCluster(seed_of_group=int(seeds[g])) for g in range(G)]
+    prop_count = [0] * G
+    fault_until = 0
+    for rnd in range(ROUNDS):
+        # ---- fault schedule (identical on both sides) --------------------
+        if rnd >= fault_until:
+            kc.dropped_links.clear()
+            kc.isolated.clear()
+            roll = rng.random()
+            if roll < 0.12:
+                kc.isolated.add(rng.randrange(N))
+                fault_until = rnd + rng.randrange(2, 8)
+            elif roll < 0.22:
+                a, b = rng.sample(range(N), 2)
+                kc.dropped_links.add((a, b))
+                if rng.random() < 0.5:
+                    kc.dropped_links.add((b, a))
+                fault_until = rnd + rng.randrange(2, 8)
+            for sc in scs:
+                sc.dropped_links = set(kc.dropped_links)
+                sc.isolated = set(kc.isolated)
+        # ---- injections --------------------------------------------------
+        if rng.random() < 0.5:
+            g = rng.randrange(G)
+            lead = kc.leader_of(g)
+            slead = [h for h, r in scs[g].rafts.items() if r.is_leader()]
+            if (
+                lead is not None
+                and slead
+                and slead[0] - 1 == lead
+                and lead not in kc.isolated
+                and prop_count[g] < 300
+            ):
+                n = rng.randrange(1, 4)
+                prop_count[g] += n
+                kc.propose(lead, g, n=n)
+                scs[g].propose(lead + 1, n=n)
+        if rng.random() < 0.03:
+            g = rng.randrange(G)
+            lead = kc.leader_of(g)
+            if lead is not None and lead not in kc.isolated:
+                target = rng.randrange(N)
+                if target != lead:
+                    kc.transfer_leader(lead, g, target)
+                    scs[g].rafts[lead + 1].handle(
+                        Message(
+                            type=MT.LEADER_TRANSFER, to=lead + 1,
+                            from_=target + 1,
+                            term=scs[g].rafts[lead + 1].term,
+                            hint=target + 1,
+                        )
+                    )
+        # ---- advance both sides identically ------------------------------
+        kc.settle(20)
+        for sc in scs:
+            sc.settle(20)
+        kc.step(tick=True)
+        kc.settle(20)
+        for sc in scs:
+            sc.tick_all()
+            sc.settle(20)
+        for g in range(G):
+            _compare_group(kc, scs, g, f"rnd={rnd}")
+    # after the storm: heal, re-elect where needed, and verify full logs
+    kc.dropped_links.clear()
+    kc.isolated.clear()
+    for sc in scs:
+        sc.dropped_links = set()
+        sc.isolated = set()
+    for _ in range(4 * ELECTION):
+        kc.step(tick=True)
+        kc.settle(20)
+        for sc in scs:
+            sc.tick_all()
+            sc.settle(20)
+    for g in range(G):
+        _compare_group(kc, scs, g, "final")
+        hi = scs[g].observables()[0]["committed"]
+        for h in range(N):
+            if hi >= 1:
+                assert kc.ring_terms(h, g, 1, hi) == scs[g].log_terms(
+                    h + 1, 1, hi
+                ), f"g={g} h={h} log terms diverged"
 
 
 def test_differential_leader_transfer(clusters):

@@ -197,3 +197,74 @@ def test_logdb_reopen_native(tmp_path):
     ents, _ = db2.iterate_entries(1, 1, 1, 6, 1 << 30)
     assert len(ents) == 5
     db2.close()
+
+
+def test_segmented_compaction_roll_and_replay(tmp_path):
+    """Round-3 segmented compaction: sealing the WAL is an O(1) rename;
+    state survives restart across table.log + segments + live WAL."""
+    d = str(tmp_path / "seg")
+    kv = NativeWalKV(d)
+    for i in range(20):
+        kv.put_value(b"k%03d" % i, b"v%d" % i)
+    assert kv.segment_count() == 0
+    kv.roll_segment()
+    assert kv.segment_count() == 1
+    for i in range(20, 40):
+        kv.put_value(b"k%03d" % i, b"v%d" % i)
+    kv.delete_value(b"k001")
+    kv.roll_segment()
+    assert kv.segment_count() == 2
+    kv.put_value(b"tail", b"t")
+    kv.close()
+    # restart: replay table + segments + wal in order
+    kv2 = NativeWalKV(d)
+    assert kv2.get_value(b"k000") == b"v0"
+    assert kv2.get_value(b"k001") is None
+    assert kv2.get_value(b"k039") == b"v39"
+    assert kv2.get_value(b"tail") == b"t"
+    assert kv2.segment_count() == 2
+    kv2.close()
+
+
+def test_segment_tier_merge_bounds_segment_count(tmp_path):
+    """Crossing the segment bound merges the oldest tier; live data
+    survives, deletions from newer segments still apply on replay."""
+    d = str(tmp_path / "tier")
+    kv = NativeWalKV(d)
+    for round_ in range(12):
+        for i in range(8):
+            kv.put_value(b"r%02d-%d" % (round_, i), b"x" * 32)
+        if round_ == 5:
+            kv.delete_value(b"r00-0")
+        kv.roll_segment()
+    # force the tier merge through the maybe-compact path: one pending op
+    # crosses threshold=1, rolls the WAL, and segment_count > 8 merges the
+    # oldest half into ONE compacted segment
+    kv.put_value(b"final", b"y")
+    before = kv.segment_count()
+    kv.maybe_compact(threshold=1)
+    assert kv.segment_count() < before
+    kv.close()
+    kv2 = NativeWalKV(d)
+    assert kv2.get_value(b"r00-0") is None
+    assert kv2.get_value(b"r00-1") == b"x" * 32
+    assert kv2.get_value(b"r11-7") == b"x" * 32
+    assert kv2.get_value(b"final") == b"y"
+    kv2.close()
+
+
+def test_full_compaction_clears_segments(tmp_path):
+    d = str(tmp_path / "full")
+    kv = NativeWalKV(d)
+    for i in range(30):
+        kv.put_value(b"f%03d" % i, b"v")
+        if i % 10 == 9:
+            kv.roll_segment()
+    assert kv.segment_count() == 3
+    kv.full_compaction()
+    assert kv.segment_count() == 0
+    kv.close()
+    kv2 = NativeWalKV(d)
+    assert kv2.count() == 30
+    assert kv2.get_value(b"f029") == b"v"
+    kv2.close()

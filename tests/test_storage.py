@@ -121,10 +121,12 @@ def test_logdb_entry_overwrite_suffix(logdb):
     logdb.save_raft_state([mk_update(1, 1, [ent(i, 1) for i in range(1, 6)], State(term=1, commit=0))])
     logdb.save_raft_state([mk_update(1, 1, [ent(i, 2) for i in range(3, 5)], State(term=2, commit=0))])
     ents, _ = logdb.iterate_entries(1, 1, 1, 10, 2**32)
-    # maxIndex is 4 now; entry 5 (stale term-1) must not be returned
-    assert [e.index for e in ents] == [1, 2, 3, 4, 5]
-    # NOTE: the contiguity guard stops at holes, stale entry 5 still
-    # contiguous here — read_raft_state's entry_count uses maxIndex:
+    # the batched layout's merge drops the stale suffix that shared the
+    # rewritten batch (cf. batch.go:60-126: old entries survive only
+    # below the rewrite point), so the stale term-1 entry 5 is GONE
+    assert [(e.index, e.term) for e in ents] == [
+        (1, 1), (2, 1), (3, 2), (4, 2)
+    ]
     rs = logdb.read_raft_state(1, 1, 0)
     assert rs.entry_count == 4
 
