@@ -105,6 +105,14 @@ class Node:
         self.sm = StateMachineManager(snapshotter, managed, self, cfg)
         if snapshotter is not None:
             snapshotter.bind_sm(self.sm)
+        if self.sm.on_disk_state_machine():
+            # open the user's on-disk state BEFORE the protocol core (and
+            # any snapshot recovery / log replay) runs: the returned index
+            # seeds the manager's skip-until cursor so already-persisted
+            # entries are not re-applied, and step_node's applied-cursor
+            # notifications start from it (cf. statemachine.go:374-389
+            # OpenOnDiskStateMachine; node.go:553-583)
+            self.sm.open()
         # snapshot bookkeeping
         self._applied_since_snapshot = 0
         self._snapshot_lock = threading.Lock()

@@ -111,11 +111,17 @@ class RequestResult:
         return self.code == REQUEST_DROPPED
 
 
+# guards the callback handoff in RequestState._fire_cb; module-level so
+# the per-request fast path (no callback registered) stays lock-free
+_cb_fire_mu = threading.Lock()
+
+
 class RequestState:
     """One in-flight request (cf. requests.go:267-329). wait() blocks the
     calling thread; the engine thread completes it via notify()."""
 
-    __slots__ = ("key", "client_id", "series_id", "deadline", "_event", "_result")
+    __slots__ = ("key", "client_id", "series_id", "deadline", "_event",
+                 "_result", "_cb")
 
     def __init__(self) -> None:
         self.key = 0
@@ -124,10 +130,29 @@ class RequestState:
         self.deadline = 0
         self._event = threading.Event()
         self._result: Optional[RequestResult] = None
+        self._cb = None
 
     def notify(self, result: RequestResult) -> None:
         self._result = result
         self._event.set()
+        if self._cb is not None:
+            self._fire_cb()
+
+    def on_complete(self, cb) -> None:
+        """Invoke cb(self) exactly once when the request completes — from
+        the completing engine thread, so cb must be brief and non-blocking
+        (used by the embedding ABI's event delivery; cf. the reference's
+        Event.Set discipline, binding dragonboat.h:377-394). Fires
+        immediately if already complete."""
+        self._cb = cb
+        if self._event.is_set():
+            self._fire_cb()
+
+    def _fire_cb(self) -> None:
+        with _cb_fire_mu:  # exactly-once between notify and on_complete
+            cb, self._cb = self._cb, None
+        if cb is not None:
+            cb(self)
 
     def wait(self, timeout: Optional[float] = None) -> RequestResult:
         if not self._event.wait(timeout):

@@ -5,11 +5,13 @@
  * over the Go runtime): here the runtime is the Python host framework,
  * embedded via libpython behind this flat C API. State machines are C++
  * plugins built against native/sm_sdk/dragonboat_tpu/statemachine.h —
- * a C/C++ application never touches Python.
+ * a C/C++ application never touches Python. The OO C++ wrapper over this
+ * ABI lives in dragonboat_tpu.hpp (cf. reference dragonboat.h).
  *
  * Threading: dbtpu_init() starts the runtime (call once, any thread);
  * every other call is safe from any thread. Errors are returned as
- * negative codes with a message copied into the caller's err buffer.
+ * negative DBTPU_ERR_* codes with a message copied into the caller's err
+ * buffer (cf. binding.h's statusCode constants).
  *
  * Configs cross the ABI as JSON strings matching the Python dataclass
  * field names (config.py NodeHostConfig / Config), e.g.
@@ -28,12 +30,43 @@
 extern "C" {
 #endif
 
-typedef uint64_t dbtpu_nodehost;  /* opaque handle; 0 is invalid */
+typedef uint64_t dbtpu_nodehost; /* opaque handle; 0 is invalid */
+typedef uint64_t dbtpu_session;  /* opaque client-session handle */
+typedef uint64_t dbtpu_request;  /* opaque in-flight request handle */
+
+/* Result codes (cf. reference binding.h statusCode). 0 is success; every
+ * other value is negative. Framework exceptions crossing the ABI are
+ * classified into these by exception type. */
+#define DBTPU_OK 0
+#define DBTPU_ERR -1 /* unclassified failure; message in err buffer */
+#define DBTPU_ERR_TIMEOUT -2
+#define DBTPU_ERR_CANCELED -3
+#define DBTPU_ERR_REJECTED -4
+#define DBTPU_ERR_CLUSTER_NOT_FOUND -5
+#define DBTPU_ERR_CLUSTER_NOT_READY -6
+#define DBTPU_ERR_CLUSTER_CLOSED -7
+#define DBTPU_ERR_SYSTEM_BUSY -8
+#define DBTPU_ERR_INVALID_SESSION -9
+#define DBTPU_ERR_TIMEOUT_TOO_SMALL -10
+#define DBTPU_ERR_PAYLOAD_TOO_BIG -11
+#define DBTPU_ERR_SYSTEM_STOPPED -12
+#define DBTPU_ERR_CLUSTER_ALREADY_EXIST -13
+#define DBTPU_ERR_INVALID_CLUSTER_SETTINGS -14
+#define DBTPU_ERR_DEADLINE_NOT_SET -15
+#define DBTPU_ERR_DIR_NOT_EXIST -16
+#define DBTPU_ERR_DIR_LOCKED -17
 
 /* Start / stop the embedded runtime. init is idempotent; returns 0 on
  * success. */
 int dbtpu_init(void);
 void dbtpu_finalize(void);
+
+/* Classified DBTPU_ERR_* code of the calling thread's most recent failed
+ * ABI call (errno-style). Handle-returning entry points (nodehost_new,
+ * session_noop/open, propose, read_index) report failure as a 0 handle;
+ * this recovers WHICH error it was. Reset to DBTPU_OK by successful
+ * calls. */
+int dbtpu_last_error(void);
 
 /* NodeHost lifecycle. Returns 0 handle on failure (message in err). */
 dbtpu_nodehost dbtpu_nodehost_new(const char* config_json, char* err,
@@ -41,7 +74,9 @@ dbtpu_nodehost dbtpu_nodehost_new(const char* config_json, char* err,
 int dbtpu_nodehost_stop(dbtpu_nodehost nh, char* err, int errlen);
 
 /* Start a Raft group whose state machine is the C++ plugin at
- * plugin_path (built with DBTPU_REGISTER_STATEMACHINE).
+ * plugin_path (built with one of the DBTPU_REGISTER_*_STATEMACHINE
+ * macros; the plugin's exported dbtpu_sm_type() selects the regular /
+ * concurrent / on-disk apply discipline).
  * members_json: {"1":"addr1","2":"addr2"} ({} on restart/join). */
 int dbtpu_start_cluster(dbtpu_nodehost nh, const char* members_json,
                         int join, const char* plugin_path,
@@ -50,18 +85,99 @@ int dbtpu_start_cluster(dbtpu_nodehost nh, const char* members_json,
 int dbtpu_stop_cluster(dbtpu_nodehost nh, uint64_t cluster_id, char* err,
                        int errlen);
 
+/* ------------------------------------------------------------- sessions
+ * Client sessions provide at-most-once proposal semantics (cf. reference
+ * client package / Session class in dragonboat.h:297-340). Handles are
+ * owned by the caller; release noop sessions with dbtpu_session_release,
+ * registered sessions with dbtpu_session_close. */
+
+/* NOOP session: proposals are applied without dedup enforcement. */
+dbtpu_session dbtpu_session_noop(dbtpu_nodehost nh, uint64_t cluster_id,
+                                 char* err, int errlen);
+/* Register a real client session on the cluster (quorum round-trip). */
+dbtpu_session dbtpu_session_open(dbtpu_nodehost nh, uint64_t cluster_id,
+                                 double timeout_s, char* err, int errlen);
+/* Unregister a registered session and release the handle. */
+int dbtpu_session_close(dbtpu_nodehost nh, dbtpu_session s,
+                        double timeout_s, char* err, int errlen);
+/* Mark the current proposal completed so the session can carry the next
+ * one (cf. Session::ProposalCompleted). */
+int dbtpu_session_proposal_completed(dbtpu_nodehost nh, dbtpu_session s,
+                                     char* err, int errlen);
+/* Drop the handle without any cluster interaction (noop sessions). */
+void dbtpu_session_release(dbtpu_nodehost nh, dbtpu_session s);
+
+/* ------------------------------------------------------------ proposals */
+
 /* Make a linearizable proposal (no-op client session); on success *result
  * receives the SM Update return value. */
 int dbtpu_sync_propose(dbtpu_nodehost nh, uint64_t cluster_id,
                        const uint8_t* cmd, size_t cmdlen, double timeout_s,
                        uint64_t* result, char* err, int errlen);
 
-/* Linearizable read (ReadIndex). *out receives a malloc'd buffer the
- * caller frees with dbtpu_free; *outlen its size. A missing value yields
- * rc 0 with *out NULL. */
+/* Same through an explicit session handle. */
+int dbtpu_sync_propose_session(dbtpu_nodehost nh, dbtpu_session s,
+                               const uint8_t* cmd, size_t cmdlen,
+                               double timeout_s, uint64_t* result,
+                               char* err, int errlen);
+
+/* Asynchronous proposal: returns a request handle immediately (0 on
+ * launch failure). Complete it with dbtpu_request_wait / _poll or attach
+ * a callback with dbtpu_request_on_complete. */
+dbtpu_request dbtpu_propose(dbtpu_nodehost nh, dbtpu_session s,
+                            const uint8_t* cmd, size_t cmdlen,
+                            double timeout_s, char* err, int errlen);
+
+/* Asynchronous ReadIndex (linearizability point for a following
+ * dbtpu_read_local). */
+dbtpu_request dbtpu_read_index(dbtpu_nodehost nh, uint64_t cluster_id,
+                               double timeout_s, char* err, int errlen);
+
+/* Block until the request completes (or wait_s elapses -> DBTPU_ERR_TIMEOUT
+ * with the handle still live). On completion the handle is released and
+ * *code receives the outcome (DBTPU_OK / DBTPU_ERR_TIMEOUT / _REJECTED /
+ * _CLUSTER_CLOSED / _CLUSTER_NOT_READY) and *result the SM value. */
+int dbtpu_request_wait(dbtpu_nodehost nh, dbtpu_request r, double wait_s,
+                       int* code, uint64_t* result, char* err, int errlen);
+
+/* Non-blocking: *done=0 if still in flight; otherwise like wait. */
+int dbtpu_request_poll(dbtpu_nodehost nh, dbtpu_request r, int* done,
+                       int* code, uint64_t* result, char* err, int errlen);
+
+/* Invoke cb(ctx, code, result) when the request completes; the handle is
+ * released after the callback returns. The callback runs on an engine
+ * worker thread: keep it brief and non-blocking (set an event, post to a
+ * queue), and never re-enter the ABI on the same request. */
+typedef void (*dbtpu_event_fn)(void* ctx, int code, uint64_t result);
+int dbtpu_request_on_complete(dbtpu_nodehost nh, dbtpu_request r,
+                              dbtpu_event_fn cb, void* ctx, char* err,
+                              int errlen);
+
+/* Abandon an in-flight request handle (the operation itself is not
+ * cancelled; its eventual result is discarded). */
+void dbtpu_request_release(dbtpu_nodehost nh, dbtpu_request r);
+
+/* ---------------------------------------------------------------- reads */
+
+/* Linearizable read (ReadIndex + local lookup). *out receives a malloc'd
+ * buffer the caller frees with dbtpu_free; *outlen its size. A missing
+ * value yields rc 0 with *out NULL. */
 int dbtpu_sync_read(dbtpu_nodehost nh, uint64_t cluster_id,
                     const uint8_t* query, size_t querylen, double timeout_s,
                     uint8_t** out, size_t* outlen, char* err, int errlen);
+
+/* Local SM lookup; linearizable ONLY after a completed dbtpu_read_index
+ * (cf. NodeHost::ReadLocal). */
+int dbtpu_read_local(dbtpu_nodehost nh, uint64_t cluster_id,
+                     const uint8_t* query, size_t querylen, uint8_t** out,
+                     size_t* outlen, char* err, int errlen);
+
+/* Local SM lookup with no linearizability guarantee. */
+int dbtpu_stale_read(dbtpu_nodehost nh, uint64_t cluster_id,
+                     const uint8_t* query, size_t querylen, uint8_t** out,
+                     size_t* outlen, char* err, int errlen);
+
+/* ----------------------------------------------------------- leadership */
 
 /* *leader_id / *has_leader via out-params; returns 0 on success. */
 int dbtpu_get_leader_id(dbtpu_nodehost nh, uint64_t cluster_id,
@@ -72,6 +188,8 @@ int dbtpu_request_leader_transfer(dbtpu_nodehost nh, uint64_t cluster_id,
                                   uint64_t target_node_id, char* err,
                                   int errlen);
 
+/* ----------------------------------------------------------- membership */
+
 /* Membership changes (synchronous). */
 int dbtpu_sync_add_node(dbtpu_nodehost nh, uint64_t cluster_id,
                         uint64_t node_id, const char* address,
@@ -79,6 +197,36 @@ int dbtpu_sync_add_node(dbtpu_nodehost nh, uint64_t cluster_id,
 int dbtpu_sync_delete_node(dbtpu_nodehost nh, uint64_t cluster_id,
                            uint64_t node_id, double timeout_s, char* err,
                            int errlen);
+int dbtpu_sync_add_observer(dbtpu_nodehost nh, uint64_t cluster_id,
+                            uint64_t node_id, const char* address,
+                            double timeout_s, char* err, int errlen);
+int dbtpu_sync_add_witness(dbtpu_nodehost nh, uint64_t cluster_id,
+                           uint64_t node_id, const char* address,
+                           double timeout_s, char* err, int errlen);
+
+/* Cluster membership as a malloc'd JSON string (free with dbtpu_free):
+ * {"config_change_id":N,"addresses":{"1":"a1",...},
+ *  "observers":{...},"witnesses":{...}} */
+int dbtpu_get_cluster_membership(dbtpu_nodehost nh, uint64_t cluster_id,
+                                 char** json_out, char* err, int errlen);
+
+/* Whether this NodeHost currently manages the cluster. */
+int dbtpu_has_cluster(dbtpu_nodehost nh, uint64_t cluster_id);
+
+/* NodeHost-wide info as malloc'd JSON (free with dbtpu_free):
+ * {"raft_address":"...","cluster_info":[{"cluster_id":1,"node_id":1,
+ *  "is_leader":true,"config_change_index":N,"nodes":{...}},...]} */
+int dbtpu_get_nodehost_info(dbtpu_nodehost nh, char** json_out, char* err,
+                            int errlen);
+
+/* ------------------------------------------------------------ snapshots */
+
+/* Request a snapshot; blocks until generated (or exported when
+ * export_path is non-empty/non-NULL). *index receives the snapshot's
+ * applied index. */
+int dbtpu_sync_request_snapshot(dbtpu_nodehost nh, uint64_t cluster_id,
+                                const char* export_path, double timeout_s,
+                                uint64_t* index, char* err, int errlen);
 
 void dbtpu_free(void* p);
 

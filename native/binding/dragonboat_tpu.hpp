@@ -1,0 +1,722 @@
+// C++ OO wrapper for embedding dragonboat-tpu in C++ applications.
+//
+// TPU-era counterpart of the reference's C++11 binding
+// (binding/include/dragonboat/dragonboat.h:41-761: NodeHost / Session /
+// RequestState / Status / Peers / Buffer / LeaderID classes over the cgo
+// C API). Here the classes wrap the flat C ABI in dragonboat_tpu.h, which
+// embeds the Python host runtime; state machines are C++ plugins built
+// against native/sm_sdk/dragonboat_tpu/statemachine.h, so a C++
+// application using this header never touches Python.
+//
+// Header-only by design: every method is a thin marshalling shim over one
+// C ABI call — there is no logic worth a separate translation unit, and
+// header-only keeps plugin/app builds to a single -ldbtpu link.
+//
+// Usage sketch:
+//   dbtpu::NodeHostConfig nhc("/tmp/nh1", "127.0.0.1:26000");
+//   dbtpu::NodeHost nh(nhc);
+//   dbtpu::Peers peers;
+//   peers.AddMember(1, "127.0.0.1:26000");
+//   nh.StartCluster(peers, false, "libdiskkv_sm.so",
+//                   dbtpu::ClusterConfig(1, 1));
+//   auto* s = nh.GetNoOPSession(1);
+//   uint64_t result;
+//   dbtpu::Status st = nh.SyncPropose(s, cmd, len, 5.0, &result);
+
+#ifndef DBTPU_DRAGONBOAT_TPU_HPP_
+#define DBTPU_DRAGONBOAT_TPU_HPP_
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "dragonboat_tpu.h"
+
+namespace dbtpu {
+
+using NodeID = uint64_t;
+using ClusterID = uint64_t;
+using UpdateResult = uint64_t;
+
+// Operation outcome (cf. reference dragonboat.h Status:199-238). Codes
+// are the DBTPU_* constants from dragonboat_tpu.h; Message() carries the
+// framework's error text when one was reported.
+class Status {
+ public:
+  Status() noexcept : code_(DBTPU_OK) {}
+  explicit Status(int code, std::string msg = "") noexcept
+      : code_(code), msg_(std::move(msg)) {}
+  int Code() const noexcept { return code_; }
+  bool OK() const noexcept { return code_ == DBTPU_OK; }
+  const std::string& Message() const noexcept { return msg_; }
+  std::string String() const noexcept {
+    switch (code_) {
+      case DBTPU_OK: return "OK";
+      case DBTPU_ERR_TIMEOUT: return "timeout";
+      case DBTPU_ERR_CANCELED: return "canceled";
+      case DBTPU_ERR_REJECTED: return "rejected";
+      case DBTPU_ERR_CLUSTER_NOT_FOUND: return "cluster not found";
+      case DBTPU_ERR_CLUSTER_NOT_READY: return "cluster not ready";
+      case DBTPU_ERR_CLUSTER_CLOSED: return "cluster closed";
+      case DBTPU_ERR_SYSTEM_BUSY: return "system busy";
+      case DBTPU_ERR_INVALID_SESSION: return "invalid session";
+      case DBTPU_ERR_TIMEOUT_TOO_SMALL: return "timeout too small";
+      case DBTPU_ERR_PAYLOAD_TOO_BIG: return "payload too big";
+      case DBTPU_ERR_SYSTEM_STOPPED: return "system stopped";
+      case DBTPU_ERR_CLUSTER_ALREADY_EXIST: return "cluster already exists";
+      case DBTPU_ERR_INVALID_CLUSTER_SETTINGS:
+        return "invalid cluster settings";
+      case DBTPU_ERR_DEADLINE_NOT_SET: return "deadline not set";
+      case DBTPU_ERR_DIR_NOT_EXIST: return "directory does not exist";
+      case DBTPU_ERR_DIR_LOCKED: return "directory locked";
+      default: return "error";
+    }
+  }
+
+ private:
+  int code_;
+  std::string msg_;
+};
+
+namespace detail {
+
+inline std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// Minimal scanner for the flat {"k":"v"|N,...} / one-level-nested JSON
+// the ABI returns; extracts a string-map field like "addresses".
+inline std::map<uint64_t, std::string> parse_u64_str_map(
+    const std::string& json, const std::string& field) {
+  std::map<uint64_t, std::string> out;
+  std::string needle = "\"" + field + "\"";
+  size_t at = json.find(needle);
+  if (at == std::string::npos) return out;
+  at = json.find('{', at);
+  if (at == std::string::npos) return out;
+  size_t end = json.find('}', at);
+  if (end == std::string::npos) return out;
+  size_t pos = at + 1;
+  while (pos < end) {
+    size_t k0 = json.find('"', pos);
+    if (k0 == std::string::npos || k0 >= end) break;
+    size_t k1 = json.find('"', k0 + 1);
+    size_t colon = json.find(':', k1);
+    size_t v0 = json.find('"', colon);
+    if (v0 == std::string::npos || v0 >= end) break;
+    size_t v1 = json.find('"', v0 + 1);
+    uint64_t key = std::strtoull(json.substr(k0 + 1, k1 - k0 - 1).c_str(),
+                                 nullptr, 10);
+    out[key] = json.substr(v0 + 1, v1 - v0 - 1);
+    pos = v1 + 1;
+  }
+  return out;
+}
+
+inline uint64_t parse_u64_field(const std::string& json,
+                                const std::string& field) {
+  std::string needle = "\"" + field + "\"";
+  size_t at = json.find(needle);
+  if (at == std::string::npos) return 0;
+  size_t colon = json.find(':', at);
+  if (colon == std::string::npos) return 0;
+  return std::strtoull(json.c_str() + colon + 1, nullptr, 10);
+}
+
+}  // namespace detail
+
+// Raft-node configuration (cf. reference dragonboat.h Config:84-121).
+// Field names mirror the framework's config.py Config dataclass; the
+// struct serializes itself to the JSON the C ABI expects.
+class ClusterConfig {
+ public:
+  ClusterConfig(ClusterID cluster_id, NodeID node_id) noexcept
+      : ClusterId(cluster_id), NodeId(node_id) {}
+  ClusterID ClusterId;
+  NodeID NodeId;
+  bool IsObserver = false;
+  bool IsWitness = false;
+  bool CheckQuorum = false;
+  bool Quiesce = false;
+  uint64_t ElectionRTT = 10;
+  uint64_t HeartbeatRTT = 1;
+  uint64_t SnapshotEntries = 0;
+  uint64_t CompactionOverhead = 0;
+  bool OrderedConfigChange = false;
+  uint64_t MaxInMemLogSize = 0;
+  // 0 = none, 1 = snappy (cf. types.py CompressionType IntEnum)
+  int EntryCompressionType = 0;
+  int SnapshotCompressionType = 0;
+
+  std::string ToJson() const {
+    std::ostringstream o;
+    o << "{\"cluster_id\":" << ClusterId << ",\"node_id\":" << NodeId
+      << ",\"is_observer\":" << (IsObserver ? "true" : "false")
+      << ",\"is_witness\":" << (IsWitness ? "true" : "false")
+      << ",\"check_quorum\":" << (CheckQuorum ? "true" : "false")
+      << ",\"quiesce\":" << (Quiesce ? "true" : "false")
+      << ",\"election_rtt\":" << ElectionRTT
+      << ",\"heartbeat_rtt\":" << HeartbeatRTT
+      << ",\"snapshot_entries\":" << SnapshotEntries
+      << ",\"compaction_overhead\":" << CompactionOverhead
+      << ",\"ordered_config_change\":"
+      << (OrderedConfigChange ? "true" : "false")
+      << ",\"max_in_mem_log_size\":" << MaxInMemLogSize
+      << ",\"entry_compression_type\":" << EntryCompressionType
+      << ",\"snapshot_compression_type\":" << SnapshotCompressionType
+      << "}";
+    return o.str();
+  }
+};
+
+// NodeHost configuration (cf. reference dragonboat.h NodeHostConfig:
+// 127-177). Mirrors config.py NodeHostConfig.
+class NodeHostConfig {
+ public:
+  NodeHostConfig(std::string node_host_dir, std::string raft_address) noexcept
+      : NodeHostDir(std::move(node_host_dir)),
+        RaftAddress(std::move(raft_address)) {}
+  uint64_t DeploymentID = 0;
+  std::string NodeHostDir;
+  std::string WALDir;
+  uint64_t RTTMillisecond = 10;
+  std::string RaftAddress;
+  std::string ListenAddress;
+  bool MutualTLS = false;
+  std::string CAFile;
+  std::string CertFile;
+  std::string KeyFile;
+
+  std::string ToJson() const {
+    std::ostringstream o;
+    o << "{\"deployment_id\":" << DeploymentID << ",\"rtt_millisecond\":"
+      << RTTMillisecond << ",\"nodehost_dir\":\""
+      << detail::json_escape(NodeHostDir) << "\",\"raft_address\":\""
+      << detail::json_escape(RaftAddress) << "\"";
+    if (!WALDir.empty()) {
+      o << ",\"wal_dir\":\"" << detail::json_escape(WALDir) << "\"";
+    }
+    if (!ListenAddress.empty()) {
+      o << ",\"listen_address\":\"" << detail::json_escape(ListenAddress)
+        << "\"";
+    }
+    if (MutualTLS) {
+      o << ",\"mutual_tls\":true,\"ca_file\":\""
+        << detail::json_escape(CAFile) << "\",\"cert_file\":\""
+        << detail::json_escape(CertFile) << "\",\"key_file\":\""
+        << detail::json_escape(KeyFile) << "\"";
+    }
+    o << "}";
+    return o.str();
+  }
+};
+
+// Initial membership for StartCluster (cf. reference Peers:242-253).
+class Peers {
+ public:
+  void AddMember(NodeID node_id, std::string address) noexcept {
+    members_[node_id] = std::move(address);
+  }
+  size_t Len() const noexcept { return members_.size(); }
+  const std::map<NodeID, std::string>& GetMembership() const noexcept {
+    return members_;
+  }
+  std::string ToJson() const {
+    std::ostringstream o;
+    o << "{";
+    bool first = true;
+    for (const auto& kv : members_) {
+      if (!first) o << ",";
+      first = false;
+      o << "\"" << kv.first << "\":\"" << detail::json_escape(kv.second)
+        << "\"";
+    }
+    o << "}";
+    return o.str();
+  }
+
+ private:
+  std::map<NodeID, std::string> members_;
+};
+
+// Local leader knowledge (cf. reference LeaderID:281-295).
+class LeaderID {
+ public:
+  NodeID GetLeaderID() const noexcept { return node_id_; }
+  bool HasLeaderInfo() const noexcept { return has_info_; }
+
+ private:
+  NodeID node_id_ = 0;
+  bool has_info_ = false;
+  friend class NodeHost;
+};
+
+// Linearizable cluster membership (cf. reference GetClusterMembership).
+struct Membership {
+  uint64_t ConfigChangeID = 0;
+  std::map<NodeID, std::string> Addresses;
+  std::map<NodeID, std::string> Observers;
+  std::map<NodeID, std::string> Witnesses;
+};
+
+// Per-cluster details in NodeHostInfo (cf. reference ClusterInfo:422-445).
+struct ClusterInfo {
+  ClusterID ClusterId = 0;
+  NodeID NodeId = 0;
+  bool IsLeader = false;
+  uint64_t ConfigChangeIndex = 0;
+  std::map<NodeID, std::string> Nodes;
+};
+
+struct NodeHostInfo {
+  std::string RaftAddress;
+  std::vector<ClusterInfo> ClusterInfoList;
+};
+
+// The outcome delivered to an Event or RequestState (cf. reference
+// RequestResult:358-366). code is DBTPU_OK on success.
+struct RequestResult {
+  int code = DBTPU_ERR;
+  uint64_t result = 0;
+  bool Completed() const noexcept { return code == DBTPU_OK; }
+};
+
+// Completion notification base for async operations (cf. reference
+// Event:377-394): the runtime invokes Set() exactly once from one of its
+// worker threads; subclasses bridge to a condition variable, eventfd,
+// io_service post, etc.
+class Event {
+ public:
+  Event() noexcept {}
+  virtual ~Event() {}
+  void Set(int code, uint64_t result) noexcept {
+    result_.code = code;
+    result_.result = result;
+    set();
+  }
+  RequestResult Get() const noexcept { return result_; }
+
+ protected:
+  virtual void set() noexcept = 0;
+
+ private:
+  RequestResult result_;
+  Event(const Event&) = delete;
+  Event& operator=(const Event&) = delete;
+};
+
+class NodeHost;
+
+// Client session handle (cf. reference Session:313-340). Obtained from
+// NodeHost::GetNoOPSession / SyncGetSession; registered sessions must be
+// closed through NodeHost::SyncCloseSession. The destructor releases the
+// local handle only.
+class Session {
+ public:
+  ~Session() {
+    if (handle_ && nh_) dbtpu_session_release(nh_, handle_);
+  }
+  // Mark the current proposal completed so the session can carry the
+  // next one. No-op sessions ignore this.
+  void ProposalCompleted() noexcept {
+    if (!noop_) dbtpu_session_proposal_completed(nh_, handle_, nullptr, 0);
+  }
+  bool IsNoOPSession() const noexcept { return noop_; }
+
+ private:
+  Session(dbtpu_nodehost nh, dbtpu_session h, bool noop) noexcept
+      : nh_(nh), handle_(h), noop_(noop) {}
+  Session(const Session&) = delete;
+  Session& operator=(const Session&) = delete;
+  dbtpu_nodehost nh_;
+  dbtpu_session handle_;
+  bool noop_;
+  friend class NodeHost;
+};
+
+// In-flight async request handle (cf. reference RequestState:396-407).
+// Owned by the caller; Get() blocks for the outcome and consumes the
+// handle.
+class RequestState {
+ public:
+  ~RequestState() {
+    if (live_ && nh_) dbtpu_request_release(nh_, handle_);
+  }
+  // Block until completion (wait_s <= 0: forever). After a non-timeout
+  // return the handle is consumed.
+  RequestResult Get(double wait_s = 0) noexcept {
+    RequestResult r;
+    if (!live_) return r;
+    int rc = dbtpu_request_wait(nh_, handle_, wait_s, &r.code, &r.result,
+                                nullptr, 0);
+    if (rc == DBTPU_ERR_TIMEOUT) {
+      r.code = DBTPU_ERR_TIMEOUT;  // still in flight; handle stays live
+      return r;
+    }
+    live_ = false;
+    if (rc != DBTPU_OK) r.code = rc;
+    return r;
+  }
+  // Non-blocking check; *done false while still in flight. An ABI error
+  // (e.g. polling an already-consumed handle) is terminal: reported as
+  // done with the error in the result code, never as "still in flight".
+  RequestResult Poll(bool* done) noexcept {
+    RequestResult r;
+    int d = 0;
+    if (!live_) {
+      if (done) *done = true;
+      return r;  // code DBTPU_ERR: consumed/never-launched handle
+    }
+    int rc =
+        dbtpu_request_poll(nh_, handle_, &d, &r.code, &r.result, nullptr, 0);
+    if (rc != DBTPU_OK) {
+      live_ = false;
+      r.code = rc;
+      d = 1;
+    } else if (d) {
+      live_ = false;
+    }
+    if (done) *done = d != 0;
+    return r;
+  }
+
+ private:
+  RequestState(dbtpu_nodehost nh, dbtpu_request h) noexcept
+      : nh_(nh), handle_(h), live_(h != 0) {}
+  RequestState(const RequestState&) = delete;
+  RequestState& operator=(const RequestState&) = delete;
+  dbtpu_nodehost nh_;
+  dbtpu_request handle_;
+  bool live_;
+  friend class NodeHost;
+};
+
+// The C++ face of the framework's NodeHost (cf. reference dragonboat.h
+// NodeHost:484-735 and the Python nodehost.py facade the ABI drives).
+class NodeHost {
+ public:
+  explicit NodeHost(const NodeHostConfig& config) noexcept {
+    dbtpu_init();
+    char err[256] = {0};
+    handle_ = dbtpu_nodehost_new(config.ToJson().c_str(), err, sizeof(err));
+    last_error_ = err;
+  }
+  ~NodeHost() { Stop(); }
+
+  // Whether construction produced a usable NodeHost; LastError() has the
+  // failure text otherwise.
+  bool Valid() const noexcept { return handle_ != 0; }
+  const std::string& LastError() const noexcept { return last_error_; }
+
+  void Stop() noexcept {
+    if (handle_) {
+      dbtpu_nodehost_stop(handle_, nullptr, 0);
+      handle_ = 0;
+    }
+  }
+
+  // Start a Raft group whose SM is the plugin .so built against the SM
+  // SDK (regular / concurrent / on-disk — the plugin self-describes via
+  // dbtpu_sm_type). Initial members come from `replicas`; pass join=true
+  // with empty replicas to join, or empty replicas on restart.
+  Status StartCluster(const Peers& replicas, bool join,
+                      const std::string& plugin_file,
+                      const ClusterConfig& config) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_start_cluster(handle_, replicas.ToJson().c_str(),
+                                 join ? 1 : 0, plugin_file.c_str(),
+                                 config.ToJson().c_str(), err, sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status StopCluster(ClusterID cluster_id) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_stop_cluster(handle_, cluster_id, err, sizeof(err));
+    return Status(rc, err);
+  }
+
+  // ---------------------------------------------------------- sessions
+
+  // NOOP session (no at-most-once enforcement); caller owns the result.
+  Session* GetNoOPSession(ClusterID cluster_id) noexcept {
+    dbtpu_session s = dbtpu_session_noop(handle_, cluster_id, nullptr, 0);
+    return s ? new Session(handle_, s, true) : nullptr;
+  }
+
+  // Register a real client session (quorum round-trip); caller owns the
+  // result and must SyncCloseSession it.
+  Session* SyncGetSession(ClusterID cluster_id, double timeout_s,
+                          Status* status) noexcept {
+    char err[256] = {0};
+    dbtpu_session s =
+        dbtpu_session_open(handle_, cluster_id, timeout_s, err, sizeof(err));
+    if (!s) {
+      if (status) *status = Status(dbtpu_last_error(), err);
+      return nullptr;
+    }
+    if (status) *status = Status();
+    return new Session(handle_, s, false);
+  }
+
+  Status SyncCloseSession(Session* session, double timeout_s) noexcept {
+    if (!session || session->noop_) {
+      return Status(DBTPU_ERR_INVALID_SESSION);
+    }
+    char err[256] = {0};
+    int rc = dbtpu_session_close(handle_, session->handle_, timeout_s, err,
+                                 sizeof(err));
+    // on failure (e.g. timeout) the ABI keeps the handle registered so
+    // the close can be retried; only a successful close consumes it
+    if (rc == DBTPU_OK) session->handle_ = 0;
+    return Status(rc, err);
+  }
+
+  // --------------------------------------------------------- proposals
+
+  Status SyncPropose(Session* session, const uint8_t* cmd, size_t cmdlen,
+                     double timeout_s, UpdateResult* result) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_propose_session(handle_, session->handle_, cmd,
+                                        cmdlen, timeout_s, result, err,
+                                        sizeof(err));
+    return Status(rc, err);
+  }
+
+  // Async proposal; returns the caller-owned RequestState (nullptr on
+  // launch failure, reason in *status).
+  RequestState* Propose(Session* session, const uint8_t* cmd, size_t cmdlen,
+                        double timeout_s, Status* status) noexcept {
+    char err[256] = {0};
+    dbtpu_request r = dbtpu_propose(handle_, session->handle_, cmd, cmdlen,
+                                    timeout_s, err, sizeof(err));
+    if (status) *status = r ? Status() : Status(dbtpu_last_error(), err);
+    return r ? new RequestState(handle_, r) : nullptr;
+  }
+
+  // Async proposal whose completion Sets the caller's Event (cf.
+  // reference NodeHost::Propose(..., Event*), dragonboat.h:585).
+  Status Propose(Session* session, const uint8_t* cmd, size_t cmdlen,
+                 double timeout_s, Event* event) noexcept {
+    char err[256] = {0};
+    dbtpu_request r = dbtpu_propose(handle_, session->handle_, cmd, cmdlen,
+                                    timeout_s, err, sizeof(err));
+    if (!r) return Status(dbtpu_last_error(), err);
+    int rc = dbtpu_request_on_complete(handle_, r, &NodeHost::event_trampoline,
+                                       event, err, sizeof(err));
+    return Status(rc, err);
+  }
+
+  // ------------------------------------------------------------- reads
+
+  // Async ReadIndex; complete it, then ReadLocal for a linearizable read
+  // (cf. reference ReadIndex/ReadLocal split, dragonboat.h:597-607).
+  RequestState* ReadIndex(ClusterID cluster_id, double timeout_s,
+                          Status* status) noexcept {
+    char err[256] = {0};
+    dbtpu_request r =
+        dbtpu_read_index(handle_, cluster_id, timeout_s, err, sizeof(err));
+    if (status) *status = r ? Status() : Status(dbtpu_last_error(), err);
+    return r ? new RequestState(handle_, r) : nullptr;
+  }
+
+  Status ReadIndex(ClusterID cluster_id, double timeout_s,
+                   Event* event) noexcept {
+    char err[256] = {0};
+    dbtpu_request r =
+        dbtpu_read_index(handle_, cluster_id, timeout_s, err, sizeof(err));
+    if (!r) return Status(dbtpu_last_error(), err);
+    int rc = dbtpu_request_on_complete(handle_, r, &NodeHost::event_trampoline,
+                                       event, err, sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status ReadLocal(ClusterID cluster_id, const uint8_t* query,
+                   size_t querylen, std::string* result) noexcept {
+    return read_into("local", cluster_id, query, querylen, result);
+  }
+
+  Status StaleRead(ClusterID cluster_id, const uint8_t* query,
+                   size_t querylen, std::string* result) noexcept {
+    return read_into("stale", cluster_id, query, querylen, result);
+  }
+
+  // One-call linearizable read (ReadIndex + local lookup).
+  Status SyncRead(ClusterID cluster_id, const uint8_t* query,
+                  size_t querylen, double timeout_s,
+                  std::string* result) noexcept {
+    char err[256] = {0};
+    uint8_t* out = nullptr;
+    size_t outlen = 0;
+    int rc = dbtpu_sync_read(handle_, cluster_id, query, querylen, timeout_s,
+                             &out, &outlen, err, sizeof(err));
+    if (rc == DBTPU_OK && result) {
+      result->assign(reinterpret_cast<char*>(out), outlen);
+    }
+    if (out) dbtpu_free(out);
+    return Status(rc, err);
+  }
+
+  // -------------------------------------------------------- leadership
+
+  Status GetLeaderID(ClusterID cluster_id, LeaderID* leader) noexcept {
+    char err[256] = {0};
+    uint64_t lid = 0;
+    int has = 0;
+    int rc = dbtpu_get_leader_id(handle_, cluster_id, &lid, &has, err,
+                                 sizeof(err));
+    if (rc == DBTPU_OK && leader) {
+      leader->node_id_ = lid;
+      leader->has_info_ = has != 0;
+    }
+    return Status(rc, err);
+  }
+
+  Status RequestLeaderTransfer(ClusterID cluster_id,
+                               NodeID target) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_request_leader_transfer(handle_, cluster_id, target, err,
+                                           sizeof(err));
+    return Status(rc, err);
+  }
+
+  // -------------------------------------------------------- membership
+
+  Status SyncRequestAddNode(ClusterID cluster_id, NodeID node_id,
+                            const std::string& address,
+                            double timeout_s) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_add_node(handle_, cluster_id, node_id,
+                                 address.c_str(), timeout_s, err,
+                                 sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status SyncRequestDeleteNode(ClusterID cluster_id, NodeID node_id,
+                               double timeout_s) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_delete_node(handle_, cluster_id, node_id, timeout_s,
+                                    err, sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status SyncRequestAddObserver(ClusterID cluster_id, NodeID node_id,
+                                const std::string& address,
+                                double timeout_s) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_add_observer(handle_, cluster_id, node_id,
+                                     address.c_str(), timeout_s, err,
+                                     sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status SyncRequestAddWitness(ClusterID cluster_id, NodeID node_id,
+                               const std::string& address,
+                               double timeout_s) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_add_witness(handle_, cluster_id, node_id,
+                                    address.c_str(), timeout_s, err,
+                                    sizeof(err));
+    return Status(rc, err);
+  }
+
+  Status GetClusterMembership(ClusterID cluster_id,
+                              Membership* membership) noexcept {
+    char err[256] = {0};
+    char* json = nullptr;
+    int rc =
+        dbtpu_get_cluster_membership(handle_, cluster_id, &json, err,
+                                     sizeof(err));
+    if (rc == DBTPU_OK && membership && json) {
+      std::string j(json);
+      membership->ConfigChangeID = detail::parse_u64_field(
+          j, "config_change_id");
+      membership->Addresses = detail::parse_u64_str_map(j, "addresses");
+      membership->Observers = detail::parse_u64_str_map(j, "observers");
+      membership->Witnesses = detail::parse_u64_str_map(j, "witnesses");
+    }
+    if (json) dbtpu_free(json);
+    return Status(rc, err);
+  }
+
+  bool HasCluster(ClusterID cluster_id) noexcept {
+    return dbtpu_has_cluster(handle_, cluster_id) == 1;
+  }
+
+  // Raw NodeHost info JSON (see dragonboat_tpu.h for the schema); the
+  // typed accessor below parses the common fields.
+  Status GetNodeHostInfoJson(std::string* json_out) noexcept {
+    char err[256] = {0};
+    char* json = nullptr;
+    int rc = dbtpu_get_nodehost_info(handle_, &json, err, sizeof(err));
+    if (rc == DBTPU_OK && json_out && json) json_out->assign(json);
+    if (json) dbtpu_free(json);
+    return Status(rc, err);
+  }
+
+  // --------------------------------------------------------- snapshots
+
+  Status SyncRequestSnapshot(ClusterID cluster_id,
+                             const std::string& export_path,
+                             double timeout_s, uint64_t* index) noexcept {
+    char err[256] = {0};
+    int rc = dbtpu_sync_request_snapshot(handle_, cluster_id,
+                                         export_path.c_str(), timeout_s,
+                                         index, err, sizeof(err));
+    return Status(rc, err);
+  }
+
+ private:
+  static void event_trampoline(void* ctx, int code, uint64_t result) {
+    static_cast<Event*>(ctx)->Set(code, result);
+  }
+
+  Status read_into(const char* kind, ClusterID cluster_id,
+                   const uint8_t* query, size_t querylen,
+                   std::string* result) noexcept {
+    char err[256] = {0};
+    uint8_t* out = nullptr;
+    size_t outlen = 0;
+    int rc =
+        (kind[0] == 'l')
+            ? dbtpu_read_local(handle_, cluster_id, query, querylen, &out,
+                               &outlen, err, sizeof(err))
+            : dbtpu_stale_read(handle_, cluster_id, query, querylen, &out,
+                               &outlen, err, sizeof(err));
+    if (rc == DBTPU_OK && result) {
+      result->assign(reinterpret_cast<char*>(out), outlen);
+    }
+    if (out) dbtpu_free(out);
+    return Status(rc, err);
+  }
+
+  NodeHost(const NodeHost&) = delete;
+  NodeHost& operator=(const NodeHost&) = delete;
+  dbtpu_nodehost handle_ = 0;
+  std::string last_error_;
+};
+
+}  // namespace dbtpu
+
+#endif  // DBTPU_DRAGONBOAT_TPU_HPP_

@@ -1,0 +1,72 @@
+// Example C++ CONCURRENT state machine plugin: an in-memory KV whose
+// snapshots run concurrently with updates.
+//
+// Counterpart of the reference's concurrent test SM
+// (internal/tests/cpptest, statemachine/concurrent.h contract):
+// BatchedUpdate applies a whole committed batch in one call;
+// PrepareSnapshot captures a point-in-time copy under update mutual
+// exclusion, and SaveSnapshot streams THAT copy, so later updates never
+// leak into the image. Commands are "key=value"; lookups are the key.
+// Built by native/Makefile into build/libconcurrent_sm.so.
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "../sm_sdk/dragonboat_tpu/statemachine.h"
+#include "kv_common.h"
+
+namespace {
+
+class ConcurrentKV : public dbtpu::ConcurrentStateMachine {
+ public:
+  ConcurrentKV(uint64_t cluster_id, uint64_t node_id)
+      : dbtpu::ConcurrentStateMachine(cluster_id, node_id) {}
+
+  void BatchedUpdate(std::vector<dbtpu::Entry>* ents) override {
+    for (auto& e : *ents) {
+      std::string k, v;
+      if (!kv_example::parse_set_cmd(e.cmd, e.cmd_len, &k, &v)) {
+        e.result = 0;
+        continue;
+      }
+      table_[k] = v;
+      e.result = table_.size();
+    }
+  }
+
+  bool Lookup(const uint8_t* query, size_t len,
+              std::string* result) override {
+    auto it = table_.find(
+        std::string(reinterpret_cast<const char*>(query), len));
+    if (it == table_.end()) return false;
+    *result = it->second;
+    return true;
+  }
+
+  uint64_t GetHash() override { return kv_example::table_hash(table_); }
+
+  void* PrepareSnapshot() override {
+    return new kv_example::Table(table_);
+  }
+
+  bool SaveSnapshot(const void* ctx, dbtpu::SnapshotWriter* w) override {
+    const auto* snap = static_cast<const kv_example::Table*>(ctx);
+    bool ok = kv_example::write_table(w, *snap);
+    delete snap;
+    return ok;
+  }
+
+  bool RecoverFromSnapshot(dbtpu::SnapshotReader* r) override {
+    std::string blob;
+    if (!r->ReadAll(&blob)) return false;
+    return kv_example::read_table(blob, 0, &table_);
+  }
+
+ private:
+  kv_example::Table table_;
+};
+
+}  // namespace
+
+DBTPU_REGISTER_CONCURRENT_STATEMACHINE(ConcurrentKV)

@@ -1,0 +1,195 @@
+// Example C++ ON-DISK state machine plugin: a durable KV store.
+//
+// Counterpart of the reference's on-disk example SMs
+// (internal/tests/cpptest DiskKVTest, statemachine/ondisk.h contract):
+// the SM owns its persistence — applied entries land in an append-only
+// log under DBTPU_DISKKV_DIR/<cluster>-<node>/, Open() replays that log
+// and returns the last applied index so the runtime resumes Raft-log
+// replay from there after a restart, and Sync() fsyncs the log. Snapshots
+// stream the full table only when a lagging/joining peer needs state.
+//
+// Commands are "key=value" bytes; lookups are the key. Log record:
+//   [u64 applied_index][u32 klen][u32 vlen][key][value]
+// Built by native/Makefile into build/libdiskkv_sm.so and exercised by
+// tests/test_cpp_sm.py and the OO embedding demo (oo_demo.cc).
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../sm_sdk/dragonboat_tpu/statemachine.h"
+#include "kv_common.h"
+
+namespace {
+
+std::string data_dir(uint64_t cluster_id, uint64_t node_id) {
+  const char* root = std::getenv("DBTPU_DISKKV_DIR");
+  std::string base = root ? root : "/tmp/dbtpu-diskkv";
+  ::mkdir(base.c_str(), 0755);
+  char sub[64];
+  std::snprintf(sub, sizeof(sub), "/%llu-%llu",
+                (unsigned long long)cluster_id,
+                (unsigned long long)node_id);
+  std::string dir = base + sub;
+  ::mkdir(dir.c_str(), 0755);
+  return dir;
+}
+
+class DiskKV : public dbtpu::OnDiskStateMachine {
+ public:
+  DiskKV(uint64_t cluster_id, uint64_t node_id)
+      : dbtpu::OnDiskStateMachine(cluster_id, node_id),
+        dir_(data_dir(cluster_id, node_id)),
+        log_path_(dir_ + "/kv.log"),
+        fd_(-1),
+        io_ok_(true),
+        applied_(0) {}
+
+  ~DiskKV() override {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool Open(uint64_t* applied_index) override {
+    // replay the append-only log; a torn tail record (crash mid-write)
+    // is truncated away rather than trusted
+    FILE* f = std::fopen(log_path_.c_str(), "rb");
+    long good = 0;
+    if (f) {
+      for (;;) {
+        uint64_t idx;
+        uint32_t kl, vl;
+        if (std::fread(&idx, 8, 1, f) != 1) break;
+        if (std::fread(&kl, 4, 1, f) != 1) break;
+        if (std::fread(&vl, 4, 1, f) != 1) break;
+        std::string k(kl, '\0'), v(vl, '\0');
+        if (kl && std::fread(&k[0], 1, kl, f) != kl) break;
+        if (vl && std::fread(&v[0], 1, vl, f) != vl) break;
+        table_[k] = v;
+        applied_ = idx;
+        good = std::ftell(f);
+      }
+      std::fclose(f);
+    }
+    fd_ = ::open(log_path_.c_str(), O_WRONLY | O_CREAT, 0644);
+    if (fd_ < 0) return false;
+    if (::ftruncate(fd_, good) != 0) return false;
+    if (::lseek(fd_, 0, SEEK_END) < 0) return false;
+    io_ok_ = true;
+    *applied_index = applied_;
+    return true;
+  }
+
+  void BatchedUpdate(std::vector<dbtpu::Entry>* ents) override {
+    for (auto& e : *ents) {
+      std::string k, v;
+      if (!kv_example::parse_set_cmd(e.cmd, e.cmd_len, &k, &v)) {
+        e.result = 0;
+        continue;
+      }
+      if (!append_record(e.index, k, v)) {
+        // lost write: do NOT advance applied_ past it — a later Sync()
+        // must not certify an index whose record never hit the log
+        e.result = 0;
+        continue;
+      }
+      table_[k] = v;
+      applied_ = e.index;
+      e.result = table_.size();
+    }
+  }
+
+  bool Lookup(const uint8_t* query, size_t len,
+              std::string* result) override {
+    auto it = table_.find(
+        std::string(reinterpret_cast<const char*>(query), len));
+    if (it == table_.end()) return false;
+    *result = it->second;
+    return true;
+  }
+
+  bool Sync() override {
+    return io_ok_ && fd_ >= 0 && ::fsync(fd_) == 0;
+  }
+
+  uint64_t GetHash() override { return kv_example::table_hash(table_); }
+
+  void* PrepareSnapshot() override {
+    // point-in-time copy: later BatchedUpdates must not leak into the
+    // stream a concurrent SaveSnapshot emits
+    return new Snapshot{applied_, table_};
+  }
+
+  bool SaveSnapshot(const void* ctx, dbtpu::SnapshotWriter* w) override {
+    const auto* snap = static_cast<const Snapshot*>(ctx);
+    bool ok = w->Write(&snap->applied, 8) &&
+              kv_example::write_table(w, snap->table);
+    delete snap;
+    return ok;
+  }
+
+  bool RecoverFromSnapshot(dbtpu::SnapshotReader* r) override {
+    std::string blob;
+    if (!r->ReadAll(&blob)) return false;
+    if (blob.size() < 8) return false;
+    uint64_t applied;
+    std::memcpy(&applied, blob.data(), 8);
+    if (!kv_example::read_table(blob, 8, &table_)) return false;
+    // rebuild the local log so a restart after install replays to the
+    // snapshot's applied index
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = ::open(log_path_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd_ < 0) return false;
+    io_ok_ = true;
+    applied_ = applied;
+    for (const auto& kv : table_) {
+      if (!append_record(applied, kv.first, kv.second)) return false;
+    }
+    return ::fsync(fd_) == 0;
+  }
+
+ private:
+  struct Snapshot {
+    uint64_t applied;
+    kv_example::Table table;
+  };
+
+  // Append one record; false (and io_ok_ latched false) on a failed or
+  // short write — the log tail is undefined from then on.
+  bool append_record(uint64_t idx, const std::string& k,
+                     const std::string& v) {
+    if (!io_ok_) return false;
+    uint32_t kl = static_cast<uint32_t>(k.size());
+    uint32_t vl = static_cast<uint32_t>(v.size());
+    std::string rec;
+    rec.reserve(16 + kl + vl);
+    rec.append(reinterpret_cast<const char*>(&idx), 8);
+    rec.append(reinterpret_cast<const char*>(&kl), 4);
+    rec.append(reinterpret_cast<const char*>(&vl), 4);
+    rec.append(k);
+    rec.append(v);
+    ssize_t n = ::write(fd_, rec.data(), rec.size());
+    if (n != (ssize_t)rec.size()) {
+      io_ok_ = false;
+      return false;
+    }
+    return true;
+  }
+
+  std::string dir_;
+  std::string log_path_;
+  int fd_;
+  bool io_ok_;
+  uint64_t applied_;
+  kv_example::Table table_;
+};
+
+}  // namespace
+
+DBTPU_REGISTER_ONDISK_STATEMACHINE(DiskKV)

@@ -1,0 +1,89 @@
+// Shared helpers for the example KV state machine plugins (regular /
+// concurrent / on-disk): command parsing, the content hash used for
+// cross-replica equality checks, and the length-prefixed snapshot codec.
+// One definition here keeps the three plugins' wire/hash behavior
+// identical — they are compared against each other in tests.
+
+#ifndef DBTPU_EXAMPLES_KV_COMMON_H_
+#define DBTPU_EXAMPLES_KV_COMMON_H_
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+
+#include "../sm_sdk/dragonboat_tpu/statemachine.h"
+
+namespace kv_example {
+
+using Table = std::map<std::string, std::string>;
+
+// "key=value" -> (key, value); false when '=' is missing.
+inline bool parse_set_cmd(const uint8_t* data, size_t len, std::string* k,
+                          std::string* v) {
+  std::string cmd(reinterpret_cast<const char*>(data), len);
+  size_t eq = cmd.find('=');
+  if (eq == std::string::npos) return false;
+  *k = cmd.substr(0, eq);
+  *v = cmd.substr(eq + 1);
+  return true;
+}
+
+// FNV-1a over length-prefixed sorted records (std::map is ordered); the
+// length prefixes make record boundaries unambiguous so distinct states
+// can't collide by concatenation.
+inline uint64_t table_hash(const Table& table) {
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](const std::string& s) {
+    uint64_t n = s.size();
+    for (int i = 0; i < 8; i++) {
+      h = (h ^ static_cast<uint8_t>(n >> (8 * i))) * 1099511628211ull;
+    }
+    for (char c : s) {
+      h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
+    }
+  };
+  for (const auto& kv : table) {
+    mix(kv.first);
+    mix(kv.second);
+  }
+  return h;
+}
+
+// Stream the table as [u32 klen][key][u32 vlen][value] records.
+inline bool write_table(dbtpu::SnapshotWriter* w, const Table& table) {
+  for (const auto& kv : table) {
+    uint32_t kl = static_cast<uint32_t>(kv.first.size());
+    uint32_t vl = static_cast<uint32_t>(kv.second.size());
+    if (!w->Write(&kl, 4) || !w->Write(kv.first.data(), kl) ||
+        !w->Write(&vl, 4) || !w->Write(kv.second.data(), vl)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Decode records appended by write_table starting at blob[off]; false on
+// a malformed stream.
+inline bool read_table(const std::string& blob, size_t off, Table* table) {
+  table->clear();
+  while (off + 4 <= blob.size()) {
+    uint32_t kl;
+    std::memcpy(&kl, blob.data() + off, 4);
+    off += 4;
+    if (off + kl + 4 > blob.size()) return false;
+    std::string k = blob.substr(off, kl);
+    off += kl;
+    uint32_t vl;
+    std::memcpy(&vl, blob.data() + off, 4);
+    off += 4;
+    if (off + vl > blob.size()) return false;
+    (*table)[k] = blob.substr(off, vl);
+    off += vl;
+  }
+  return true;
+}
+
+}  // namespace kv_example
+
+#endif  // DBTPU_EXAMPLES_KV_COMMON_H_

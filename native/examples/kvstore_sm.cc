@@ -2,15 +2,15 @@
 //
 // Counterpart of the reference's C++ test SMs (internal/tests/cppkv,
 // binding/cpp examples). Commands are "key=value" bytes; lookups are the
-// key; snapshots serialize the map with length-prefixed records. Built by
-// native/Makefile into build/libkvstore_sm.so and loaded in tests through
-// dragonboat_tpu.cpp_sm.CppStateMachineFactory.
+// key; snapshots serialize the map with length-prefixed records
+// (kv_common.h). Built by native/Makefile into build/libkvstore_sm.so and
+// loaded in tests through dragonboat_tpu.cpp_sm.CppStateMachineFactory.
 
 #include <cstdint>
-#include <map>
 #include <string>
 
 #include "../sm_sdk/dragonboat_tpu/statemachine.h"
+#include "kv_common.h"
 
 namespace {
 
@@ -20,10 +20,9 @@ class KVStore : public dbtpu::RegularStateMachine {
       : dbtpu::RegularStateMachine(cluster_id, node_id) {}
 
   uint64_t Update(const uint8_t* data, size_t len) override {
-    std::string cmd(reinterpret_cast<const char*>(data), len);
-    size_t eq = cmd.find('=');
-    if (eq == std::string::npos) return 0;
-    table_[cmd.substr(0, eq)] = cmd.substr(eq + 1);
+    std::string k, v;
+    if (!kv_example::parse_set_cmd(data, len, &k, &v)) return 0;
+    table_[k] = v;
     return table_.size();
   }
 
@@ -36,63 +35,20 @@ class KVStore : public dbtpu::RegularStateMachine {
     return true;
   }
 
-  uint64_t GetHash() override {
-    // FNV-1a over length-prefixed sorted records (std::map is ordered);
-    // the length prefixes make record boundaries unambiguous so distinct
-    // states can't collide by concatenation
-    uint64_t h = 1469598103934665603ull;
-    auto mix = [&h](const std::string& s) {
-      uint64_t n = s.size();
-      for (int i = 0; i < 8; i++) {
-        h = (h ^ static_cast<uint8_t>(n >> (8 * i))) * 1099511628211ull;
-      }
-      for (char c : s) {
-        h = (h ^ static_cast<uint8_t>(c)) * 1099511628211ull;
-      }
-    };
-    for (const auto& kv : table_) {
-      mix(kv.first);
-      mix(kv.second);
-    }
-    return h;
-  }
+  uint64_t GetHash() override { return kv_example::table_hash(table_); }
 
   bool SaveSnapshot(dbtpu::SnapshotWriter* w) override {
-    for (const auto& kv : table_) {
-      uint32_t kl = static_cast<uint32_t>(kv.first.size());
-      uint32_t vl = static_cast<uint32_t>(kv.second.size());
-      if (!w->Write(&kl, 4) || !w->Write(kv.first.data(), kl) ||
-          !w->Write(&vl, 4) || !w->Write(kv.second.data(), vl)) {
-        return false;
-      }
-    }
-    return true;
+    return kv_example::write_table(w, table_);
   }
 
   bool RecoverFromSnapshot(dbtpu::SnapshotReader* r) override {
-    table_.clear();
     std::string blob;
     if (!r->ReadAll(&blob)) return false;
-    size_t off = 0;
-    while (off + 4 <= blob.size()) {
-      uint32_t kl;
-      std::memcpy(&kl, blob.data() + off, 4);
-      off += 4;
-      if (off + kl + 4 > blob.size()) return false;
-      std::string k = blob.substr(off, kl);
-      off += kl;
-      uint32_t vl;
-      std::memcpy(&vl, blob.data() + off, 4);
-      off += 4;
-      if (off + vl > blob.size()) return false;
-      table_[k] = blob.substr(off, vl);
-      off += vl;
-    }
-    return true;
+    return kv_example::read_table(blob, 0, &table_);
   }
 
  private:
-  std::map<std::string, std::string> table_;
+  kv_example::Table table_;
 };
 
 }  // namespace

@@ -2,14 +2,26 @@
 //
 // TPU-era equivalent of the reference's C++ SM SDK
 // (binding/include/dragonboat/statemachine/regular.h:43-119,
-// concurrent.h, ondisk.h + the Go-side wrapper internal/cpp/wrapper.go):
-// users subclass one of the virtual bases below, register it with
-// DBTPU_REGISTER_STATEMACHINE, compile the translation unit into a shared
-// library, and point the Python runtime at it
+// statemachine/concurrent.h:44-126, statemachine/ondisk.h:44-130 + the
+// Go-side wrapper internal/cpp/wrapper.go): users subclass one of the
+// three virtual bases below, register it with the matching
+// DBTPU_REGISTER_*_STATEMACHINE macro, compile the translation unit into a
+// shared library, and point the Python runtime at it
 // (dragonboat_tpu.cpp_sm.CppStateMachineFactory("libmysm.so")). The
 // runtime drives the SM through the flat C ABI declared at the bottom —
 // the same plugin-.so seam the reference uses for
 // NewStateMachineWrapperFromPlugin (internal/cpp/wrapper.go:226).
+//
+// The three SM classes mirror the framework's Python contracts
+// (dragonboat_tpu/statemachine.py):
+//   RegularStateMachine    — mutex-serialized in-memory SM; one Update per
+//                            committed entry; full-state snapshots.
+//   ConcurrentStateMachine — batched updates; PrepareSnapshot captures a
+//                            point-in-time context so SaveSnapshot can run
+//                            concurrently with later updates.
+//   OnDiskStateMachine     — owns its persistence: Open() returns the last
+//                            applied index after restart, Sync() fsyncs,
+//                            snapshots stream state only to lagging peers.
 //
 // Snapshot streams cross the ABI as pull/push callbacks so neither side
 // materializes the full image.
@@ -67,6 +79,16 @@ class SnapshotReader {
   void* ctx_;
 };
 
+// One committed entry in a batched update (cf. statemachine.py SMEntry and
+// the reference's dragonboat::Entry, dragonboat.h:345-354). Set `result`
+// inside BatchedUpdate; it reaches the proposing client.
+struct Entry {
+  uint64_t index;
+  const uint8_t* cmd;
+  size_t cmd_len;
+  uint64_t result;
+};
+
 // Base class users subclass (cf. regular.h RegularStateMachine).
 class RegularStateMachine {
  public:
@@ -96,33 +118,110 @@ class RegularStateMachine {
   uint64_t node_id_;
 };
 
+// Concurrent-access SM (cf. reference concurrent.h:44 and the framework's
+// IConcurrentStateMachine): BatchedUpdate calls are serialized with each
+// other and with PrepareSnapshot, but SaveSnapshot(ctx) may run
+// concurrently with later updates — it must serialize the point-in-time
+// state captured by the matching PrepareSnapshot, never the live state.
+class ConcurrentStateMachine {
+ public:
+  ConcurrentStateMachine(uint64_t cluster_id, uint64_t node_id)
+      : cluster_id_(cluster_id), node_id_(node_id) {}
+  virtual ~ConcurrentStateMachine() = default;
+
+  // Apply a batch of committed entries in index order; set each
+  // Entry::result.
+  virtual void BatchedUpdate(std::vector<Entry>* ents) = 0;
+
+  virtual bool Lookup(const uint8_t* query, size_t len,
+                      std::string* result) = 0;
+
+  virtual uint64_t GetHash() = 0;
+
+  // Capture a cheap point-in-time context (runs serialized with
+  // BatchedUpdate). Ownership passes to the next SaveSnapshot call, which
+  // must release it.
+  virtual void* PrepareSnapshot() = 0;
+
+  // Stream the state identified by ctx (NOT the live state); release ctx.
+  virtual bool SaveSnapshot(const void* ctx, SnapshotWriter* writer) = 0;
+
+  // Serialized with updates by the runtime.
+  virtual bool RecoverFromSnapshot(SnapshotReader* reader) = 0;
+
+  uint64_t cluster_id() const { return cluster_id_; }
+  uint64_t node_id() const { return node_id_; }
+
+ private:
+  uint64_t cluster_id_;
+  uint64_t node_id_;
+};
+
+// On-disk SM (cf. reference ondisk.h:44 and the framework's
+// IOnDiskStateMachine): the SM owns its persistence. After restart the
+// runtime calls Open() to learn the last applied index and resumes log
+// replay from there; Sync() must make all applied state durable;
+// snapshots only stream state to lagging or joining peers.
+class OnDiskStateMachine {
+ public:
+  OnDiskStateMachine(uint64_t cluster_id, uint64_t node_id)
+      : cluster_id_(cluster_id), node_id_(node_id) {}
+  virtual ~OnDiskStateMachine() = default;
+
+  // Open existing on-disk state; return the index of the last applied
+  // entry (0 for a fresh store), or false on failure.
+  virtual bool Open(uint64_t* applied_index) = 0;
+
+  virtual void BatchedUpdate(std::vector<Entry>* ents) = 0;
+
+  virtual bool Lookup(const uint8_t* query, size_t len,
+                      std::string* result) = 0;
+
+  // fsync all applied state; the runtime calls this before trusting the
+  // applied index to survive a crash.
+  virtual bool Sync() = 0;
+
+  virtual uint64_t GetHash() = 0;
+
+  virtual void* PrepareSnapshot() = 0;
+  virtual bool SaveSnapshot(const void* ctx, SnapshotWriter* writer) = 0;
+  virtual bool RecoverFromSnapshot(SnapshotReader* reader) = 0;
+
+  uint64_t cluster_id() const { return cluster_id_; }
+  uint64_t node_id() const { return node_id_; }
+
+ private:
+  uint64_t cluster_id_;
+  uint64_t node_id_;
+};
+
 }  // namespace dbtpu
 
 // ---------------------------------------------------------------- C ABI
-// One set of flat symbols per plugin .so, generated by the macro below.
+// One set of flat symbols per plugin .so, generated by the macros below.
+// dbtpu_sm_type() discriminates the plugin kind (values match
+// dragonboat_tpu/statemachine.py SM_TYPE_*); loaders treat a missing
+// symbol as a regular SM for back-compat with pre-type plugins.
 extern "C" {
 typedef int (*dbtpu_write_fn)(void* ctx, const uint8_t* data, size_t len);
 typedef long (*dbtpu_read_fn)(void* ctx, uint8_t* buf, size_t cap);
 }
 
-// Registers SMCLASS (a dbtpu::RegularStateMachine subclass) as THE state
-// machine exported by this shared library.
-#define DBTPU_REGISTER_STATEMACHINE(SMCLASS)                                  \
-  extern "C" {                                                                \
+#define DBTPU_SM_TYPE_REGULAR 1
+#define DBTPU_SM_TYPE_CONCURRENT 2
+#define DBTPU_SM_TYPE_ONDISK 3
+
+// Symbols shared by all three registration macros.
+#define DBTPU_SM_COMMON_(SMCLASS, TYPE)                                       \
+  int dbtpu_sm_type(void) { return (TYPE); }                                  \
   void* dbtpu_sm_create(uint64_t cluster_id, uint64_t node_id) {              \
     return new SMCLASS(cluster_id, node_id);                                  \
   }                                                                           \
-  void dbtpu_sm_destroy(void* sm) {                                           \
-    delete static_cast<dbtpu::RegularStateMachine*>(sm);                      \
-  }                                                                           \
-  uint64_t dbtpu_sm_update(void* sm, const uint8_t* data, size_t len) {       \
-    return static_cast<dbtpu::RegularStateMachine*>(sm)->Update(data, len);   \
-  }                                                                           \
+  void dbtpu_sm_destroy(void* sm) { delete static_cast<SMCLASS*>(sm); }       \
   int dbtpu_sm_lookup(void* sm, const uint8_t* query, size_t len,             \
                       uint8_t** out, size_t* outlen) {                        \
     std::string result;                                                       \
-    if (!static_cast<dbtpu::RegularStateMachine*>(sm)->Lookup(query, len,     \
-                                                              &result)) {     \
+    if (!static_cast<SMCLASS*>(sm)->Lookup(query, len, &result)) {            \
       return -1;                                                              \
     }                                                                         \
     *out = static_cast<uint8_t*>(::malloc(result.size() ? result.size() : 1));\
@@ -131,23 +230,72 @@ typedef long (*dbtpu_read_fn)(void* ctx, uint8_t* buf, size_t cap);
     return 0;                                                                 \
   }                                                                           \
   uint64_t dbtpu_sm_get_hash(void* sm) {                                      \
-    return static_cast<dbtpu::RegularStateMachine*>(sm)->GetHash();           \
-  }                                                                           \
-  int dbtpu_sm_save_snapshot(void* sm, dbtpu_write_fn w, void* ctx) {         \
-    dbtpu::SnapshotWriter writer(w, ctx);                                     \
-    return static_cast<dbtpu::RegularStateMachine*>(sm)->SaveSnapshot(        \
-               &writer)                                                       \
-               ? 0                                                            \
-               : -1;                                                          \
+    return static_cast<SMCLASS*>(sm)->GetHash();                              \
   }                                                                           \
   int dbtpu_sm_recover_snapshot(void* sm, dbtpu_read_fn r, void* ctx) {       \
     dbtpu::SnapshotReader reader(r, ctx);                                     \
-    return static_cast<dbtpu::RegularStateMachine*>(sm)                       \
-                   ->RecoverFromSnapshot(&reader)                             \
-               ? 0                                                            \
-               : -1;                                                          \
+    return static_cast<SMCLASS*>(sm)->RecoverFromSnapshot(&reader) ? 0 : -1;  \
   }                                                                           \
-  void dbtpu_sm_free(void* p) { ::free(p); }                                  \
+  void dbtpu_sm_free(void* p) { ::free(p); }
+
+// Symbols shared by the two batched-update kinds (concurrent + ondisk).
+#define DBTPU_SM_BATCHED_(SMCLASS)                                            \
+  int dbtpu_sm_batched_update(void* sm, const uint64_t* indexes,              \
+                              const uint8_t* const* cmds,                     \
+                              const size_t* lens, uint64_t* results,          \
+                              size_t n) {                                     \
+    std::vector<dbtpu::Entry> ents;                                           \
+    ents.reserve(n);                                                          \
+    for (size_t i = 0; i < n; i++) {                                          \
+      ents.push_back(dbtpu::Entry{indexes[i], cmds[i], lens[i], 0});          \
+    }                                                                         \
+    static_cast<SMCLASS*>(sm)->BatchedUpdate(&ents);                          \
+    for (size_t i = 0; i < n; i++) results[i] = ents[i].result;               \
+    return 0;                                                                 \
+  }                                                                           \
+  int dbtpu_sm_prepare_snapshot(void* sm, void** ctx) {                       \
+    *ctx = static_cast<SMCLASS*>(sm)->PrepareSnapshot();                      \
+    return 0;                                                                 \
+  }                                                                           \
+  int dbtpu_sm_save_snapshot_ctx(void* sm, void* snap_ctx, dbtpu_write_fn w,  \
+                                 void* ctx) {                                 \
+    dbtpu::SnapshotWriter writer(w, ctx);                                     \
+    return static_cast<SMCLASS*>(sm)->SaveSnapshot(snap_ctx, &writer) ? 0     \
+                                                                      : -1;   \
+  }
+
+// Registers SMCLASS (a dbtpu::RegularStateMachine subclass) as THE state
+// machine exported by this shared library.
+#define DBTPU_REGISTER_STATEMACHINE(SMCLASS)                                  \
+  extern "C" {                                                                \
+  DBTPU_SM_COMMON_(SMCLASS, DBTPU_SM_TYPE_REGULAR)                            \
+  uint64_t dbtpu_sm_update(void* sm, const uint8_t* data, size_t len) {       \
+    return static_cast<SMCLASS*>(sm)->Update(data, len);                      \
+  }                                                                           \
+  int dbtpu_sm_save_snapshot(void* sm, dbtpu_write_fn w, void* ctx) {         \
+    dbtpu::SnapshotWriter writer(w, ctx);                                     \
+    return static_cast<SMCLASS*>(sm)->SaveSnapshot(&writer) ? 0 : -1;         \
+  }                                                                           \
+  }
+
+// Registers SMCLASS (a dbtpu::ConcurrentStateMachine subclass).
+#define DBTPU_REGISTER_CONCURRENT_STATEMACHINE(SMCLASS)                       \
+  extern "C" {                                                                \
+  DBTPU_SM_COMMON_(SMCLASS, DBTPU_SM_TYPE_CONCURRENT)                         \
+  DBTPU_SM_BATCHED_(SMCLASS)                                                  \
+  }
+
+// Registers SMCLASS (a dbtpu::OnDiskStateMachine subclass).
+#define DBTPU_REGISTER_ONDISK_STATEMACHINE(SMCLASS)                           \
+  extern "C" {                                                                \
+  DBTPU_SM_COMMON_(SMCLASS, DBTPU_SM_TYPE_ONDISK)                             \
+  DBTPU_SM_BATCHED_(SMCLASS)                                                  \
+  int dbtpu_sm_open(void* sm, uint64_t* applied_index) {                      \
+    return static_cast<SMCLASS*>(sm)->Open(applied_index) ? 0 : -1;           \
+  }                                                                           \
+  int dbtpu_sm_sync(void* sm) {                                               \
+    return static_cast<SMCLASS*>(sm)->Sync() ? 0 : -1;                        \
+  }                                                                           \
   }
 
 #endif  // DBTPU_STATEMACHINE_H_

@@ -10,7 +10,9 @@ import pytest
 
 _NATIVE = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "native"))
 _DEMO = os.path.join(_NATIVE, "build", "embed_demo")
+_OO_DEMO = os.path.join(_NATIVE, "build", "oo_demo")
 _PLUGIN = os.path.join(_NATIVE, "build", "libkvstore_sm.so")
+_ONDISK_PLUGIN = os.path.join(_NATIVE, "build", "libdiskkv_sm.so")
 
 
 def _built() -> bool:
@@ -49,3 +51,23 @@ def test_embed_demo_runs(tmp_path):
     )
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "EMBED DEMO PASS" in proc.stdout
+
+
+@pytest.mark.slow
+def test_oo_demo_runs(tmp_path):
+    """Pure-C++ app over the OO wrapper (dragonboat_tpu.hpp): sessions,
+    sync/async proposals (RequestState + Event), ReadIndex/ReadLocal,
+    membership + observer add, snapshot request, restart with the on-disk
+    C++ plugin recovering its applied index (cf. reference dragonboat.h
+    NodeHost/Session/RequestState surface)."""
+    env = dict(os.environ)
+    repo = os.path.abspath(os.path.join(_NATIVE, ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DBTPU_DISKKV_DIR"] = str(tmp_path / "diskkv")
+    proc = subprocess.run(
+        [_OO_DEMO, str(tmp_path), _ONDISK_PLUGIN],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "OO DEMO PASS" in proc.stdout
