@@ -20,6 +20,7 @@ from ..types import (
     limit_entry_size,
 )
 from .. import settings
+from .rate import entries_mem_size
 
 
 class ErrCompacted(Exception):
@@ -146,15 +147,29 @@ class InMemLogDB:
 
 class InMemory:
     """Recent, not-yet-applied log entries with a saved-to watermark
-    (cf. internal/raft/inmemory.go)."""
+    (cf. internal/raft/inmemory.go). Tracks its own byte size and mirrors
+    it into an attached RateLimiter (cf. inmemory.go rl accounting) so the
+    replica can report/enforce Config.max_in_mem_log_size."""
 
-    __slots__ = ("entries", "marker_index", "saved_to", "snapshot")
+    __slots__ = ("entries", "marker_index", "saved_to", "snapshot", "_rl",
+                 "_bytes")
 
     def __init__(self, last_index: int) -> None:
         self.entries: List[Entry] = []
         self.marker_index = last_index + 1
         self.saved_to = last_index
         self.snapshot: Optional[Snapshot] = None
+        self._rl = None
+        self._bytes = 0
+
+    def set_rate_limiter(self, rl) -> None:
+        self._rl = rl
+        rl.set(self._bytes)
+
+    def _set_bytes(self, n: int) -> None:
+        self._bytes = n
+        if self._rl is not None:
+            self._rl.set(n)
 
     def get_entries(self, low: int, high: int) -> List[Entry]:
         upper = self.marker_index + len(self.entries)
@@ -206,8 +221,11 @@ class InMemory:
             return
         if index > self.entries[-1].index:
             return
+        dropped = self.entries[: index - self.marker_index]
         self.entries = self.entries[index - self.marker_index :]
         self.marker_index = index
+        if dropped:
+            self._set_bytes(max(0, self._bytes - entries_mem_size(dropped)))
 
     def saved_snapshot_to(self, index: int) -> None:
         si = self.get_snapshot_index()
@@ -225,20 +243,24 @@ class InMemory:
         tail = self.marker_index + len(self.entries)
         if first_new == tail:
             self.entries = self.entries + list(ents)
+            self._set_bytes(self._bytes + entries_mem_size(ents))
         elif first_new <= self.marker_index:
             self.marker_index = first_new
             self.entries = list(ents)
             self.saved_to = first_new - 1
+            self._set_bytes(entries_mem_size(ents))
         else:
             existing = self.get_entries(self.marker_index, first_new)
             self.entries = list(existing) + list(ents)
             self.saved_to = min(self.saved_to, first_new - 1)
+            self._set_bytes(entries_mem_size(self.entries))
 
     def restore(self, ss: Snapshot) -> None:
         self.snapshot = ss
         self.marker_index = ss.index + 1
         self.entries = []
         self.saved_to = ss.index
+        self._set_bytes(0)
 
 
 class EntryLog:

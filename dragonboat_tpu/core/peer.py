@@ -238,7 +238,11 @@ class Peer:
         r.log.commit_update(ud.update_commit)
 
     def rate_limited(self) -> bool:
-        return False
+        """Whether new proposals should be refused because some replica's
+        in-memory log exceeds Config.max_in_mem_log_size (cf.
+        node.go:1095 handleProposals -> RateLimited)."""
+        r = self.raft
+        return r.rl.enabled and r.rl.rate_limited()
 
     def local_status(self):
         r = self.raft

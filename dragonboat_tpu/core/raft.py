@@ -34,6 +34,7 @@ from ..types import (
 from ..config import Config
 from .. import settings
 from .logentry import EntryLog, ErrCompacted, ILogDB
+from .rate import RateLimiter, entries_mem_size
 from .readindex import ReadIndexTracker
 from .remote import Remote, RemoteState
 
@@ -88,6 +89,12 @@ class Raft:
         self.heartbeat_timeout = cfg.heartbeat_rtt
         self.randomized_election_timeout = 0
         self.max_entry_size = settings.soft.max_entry_size
+        # in-memory log size limiter (cf. raft.go:241 NewRateLimiter);
+        # replicas over Config.max_in_mem_log_size report to the leader,
+        # which then refuses proposals until the fleet drains
+        self.rl = RateLimiter(cfg.max_in_mem_log_size)
+        if self.rl.enabled:
+            self.log.inmem.set_rate_limiter(self.rl)
         self.events = events
         self.rng = rng if rng is not None else random.Random()
         # test-only hook mirroring reference raft.go:1460-1472
@@ -218,8 +225,15 @@ class Raft:
         else:
             self._non_leader_tick()
 
+    def _time_for_rate_limit_check(self) -> bool:
+        # one limiter tick per election timeout (cf. raft.go:543-545)
+        return self.tick_count % self.election_timeout == 0
+
     def _non_leader_tick(self) -> None:
         self.election_tick += 1
+        if self._time_for_rate_limit_check() and self.rl.enabled:
+            self.rl.tick()
+            self._send_rate_limit_message()
         # non-voting members and witnesses never campaign (thesis 4.2.1)
         if self.is_observer() or self.is_witness():
             return
@@ -230,6 +244,9 @@ class Raft:
     def _leader_tick(self) -> None:
         self._must_be_leader()
         self.election_tick += 1
+        if self._time_for_rate_limit_check() and self.rl.enabled:
+            # advance the limiter clock so stale follower reports age out
+            self.rl.tick()
         abort_transfer = self.time_to_abort_leader_transfer()
         if self.time_for_check_quorum():
             self.election_tick = 0
@@ -433,6 +450,8 @@ class Raft:
         self.state = RaftNodeState.LEADER
         self._reset(self.term)
         self.set_leader_id(self.node_id)
+        # follower reports from a previous leadership stint are meaningless
+        self.rl.reset_follower_state()
         self._pre_leader_promotion_handle_config_change()
         # commit a noop entry of the new term ASAP (thesis p72)
         self.append_entries([Entry(type=EntryType.APPLICATION)])
@@ -840,10 +859,33 @@ class Raft:
         if rp.state == RemoteState.REPLICATE:
             rp.become_retry()
 
+    def _send_rate_limit_message(self) -> None:
+        """Follower -> leader in-mem size report (cf. raft.go:660-683
+        sendRateLimitMessage): reports 0 unless this replica is over the
+        bound, and discounts not-yet-committed entries the leader itself
+        is still responsible for."""
+        if self.leader_id == NO_LEADER or not self.rl.enabled:
+            return
+        reported = 0
+        if self.rl.rate_limited():
+            inmem = self.log.inmem
+            low = max(self.log.committed + 1, inmem.marker_index)
+            high = inmem.marker_index + len(inmem.entries)
+            uncommitted = (
+                entries_mem_size(inmem.get_entries(low, high))
+                if low < high
+                else 0
+            )
+            reported = max(self.rl.get() - uncommitted, 0)
+        self._send(
+            Message(type=MT.RATE_LIMIT, to=self.leader_id, hint=reported)
+        )
+
     def _handle_leader_rate_limit(self, m: Message) -> None:
-        # Rate limiting is host-side in the TPU build; tracked per follower by
-        # the engine (cf. raft.go handleLeaderRateLimit).
-        pass
+        """Record a follower's reported in-mem log size
+        (cf. raft.go:1779-1785 handleLeaderRateLimit)."""
+        if self.rl.enabled:
+            self.rl.set_follower_state(m.from_, m.hint)
 
     # ----------------------------------------------------- follower handlers
     def _handle_follower_propose(self, m: Message) -> None:

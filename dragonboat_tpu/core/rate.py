@@ -1,0 +1,104 @@
+"""In-memory log-size rate limiting.
+
+Counterpart of the reference's RateLimiter (internal/server/rate.go:32-137):
+when Config.max_in_mem_log_size is set, each replica tracks the byte size
+of its not-yet-applied in-memory log; followers report their size to the
+leader on a logical-clock cadence (one limiter tick per election timeout,
+rate.go HeartbeatTick + raft.go:543-545 timeForRateLimitCheck), and the
+leader refuses new proposals while ANY fresh replica — itself included —
+is over the configured bound. Follower reports older than GC_TICK limiter
+ticks are discarded, so a partitioned follower cannot wedge the leader in
+the limited state forever (rate.go:102-127).
+
+The scalar core wires this through RATE_LIMIT messages (core/raft.py);
+the vector engine applies the same bound per lane host-side from its
+arena byte accounting (engine/vector.py) — device lanes never carry
+payload bytes, so the host is the only place the size is known.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# Fixed per-entry overhead charged on top of the payload: index/term/type
+# bookkeeping that exists whether or not the command is empty (the
+# reference charges the marshalled entry struct size).
+ENTRY_OVERHEAD_BYTES = 48
+
+
+def entry_mem_size(entry) -> int:
+    return ENTRY_OVERHEAD_BYTES + len(entry.cmd)
+
+
+def entries_mem_size(entries: List) -> int:
+    return sum(ENTRY_OVERHEAD_BYTES + len(e.cmd) for e in entries)
+
+
+class RateLimiter:
+    """Tracks local + reported follower in-memory log sizes against one
+    byte bound. Not thread-safe by itself: the scalar core mutates it from
+    the step worker only; the vector engine keeps one per lane under the
+    engine lock."""
+
+    GC_TICK = 2  # follower reports older than this many ticks are stale
+
+    __slots__ = ("max_bytes", "_bytes", "tick_count", "_followers")
+
+    def __init__(self, max_bytes: int) -> None:
+        self.max_bytes = max_bytes
+        self._bytes = 0
+        self.tick_count = 0
+        self._followers: Dict[int, Tuple[int, int]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # ---------------------------------------------------- logical clock
+    def tick(self) -> None:
+        self.tick_count += 1
+
+    # ----------------------------------------------------- size tracking
+    def increase(self, n: int) -> None:
+        self._bytes += n
+
+    def decrease(self, n: int) -> None:
+        self._bytes = max(0, self._bytes - n)
+
+    def set(self, n: int) -> None:
+        self._bytes = n
+
+    def get(self) -> int:
+        return self._bytes
+
+    # -------------------------------------------------- follower reports
+    def set_follower_state(self, node_id: int, n: int) -> None:
+        self._followers[node_id] = (self.tick_count, n)
+
+    def reset_follower_state(self) -> None:
+        self._followers.clear()
+
+    # ------------------------------------------------------------ verdict
+    def rate_limited(self) -> bool:
+        """True when the largest FRESH size on record exceeds the bound;
+        stale follower reports are dropped as a side effect."""
+        if not self.enabled:
+            return False
+        worst = self._bytes
+        stale = [
+            nid
+            for nid, (t, _) in self._followers.items()
+            if self.tick_count - t > self.GC_TICK
+        ]
+        for nid in stale:
+            del self._followers[nid]
+        for t, n in self._followers.values():
+            worst = max(worst, n)
+        return worst > self.max_bytes
+
+
+__all__ = [
+    "RateLimiter",
+    "entry_mem_size",
+    "entries_mem_size",
+    "ENTRY_OVERHEAD_BYTES",
+]

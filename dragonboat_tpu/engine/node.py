@@ -97,6 +97,8 @@ class Node:
         self._cc_queue: List = []
         self._leader_id = 0
         self._current_term = 0
+        self._rate_limited = False  # refreshed each step (cf. node.go:1095)
+        self._confirmed_applied = 0  # applied index confirmed into an Update
         self.initialized = threading.Event()
         # rsm manager
         managed = wrap_state_machine(
@@ -187,6 +189,11 @@ class Node:
     ) -> RequestState:
         if len(cmd) > soft.max_proposal_payload_size:
             raise ErrPayloadTooBig()
+        if self._rate_limited:
+            # some replica's in-mem log is over Config.max_in_mem_log_size;
+            # refuse new work until the fleet drains (cf. node.go:1094-1105
+            # handleProposals + requests.go ErrSystemBusy)
+            raise ErrSystemBusy()
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
         # optional payload compression at the propose boundary: the wire,
         # logdb and apply queue all carry the compressed form; replicas
@@ -234,13 +241,21 @@ class Node:
             # applied cursor feeds campaign eligibility + entry pagination
             # (cf. node.go stepNode -> p.NotifyRaftLastApplied)
             self.peer.notify_raft_last_applied(last_applied)
-            has_event = self._handle_events()
+            self._rate_limited = self.peer.rate_limited()
+            # an applied-cursor advance not yet confirmed into an Update is
+            # itself an event: without it, the LAST applies of a burst never
+            # produce the update whose commit trims them out of the in-mem
+            # log (cf. node.go:908-921 getUpdate confirmedIndex,
+            # node.go:1030-1034 handleEvents)
+            applied_advanced = last_applied != self._confirmed_applied
+            has_event = self._handle_events() or applied_advanced
             if not has_event:
                 return None
-            if not self.peer.has_update(True):
+            if not (self.peer.has_update(True) or applied_advanced):
                 # still commit the logical clock work
                 return None
             ud = self.peer.get_update(True, last_applied)
+            self._confirmed_applied = last_applied
             return ud
 
     def _handle_events(self) -> bool:

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from ..config import Config, NodeHostConfig
 from ..core.peer import PeerAddress, encode_config_change
+from ..core.rate import ENTRY_OVERHEAD_BYTES
 from ..logger import get_logger
 from ..ops.kernel import make_step_fn
 from ..ops.state import (
@@ -217,6 +218,25 @@ class VectorNode(Node):
         self._vec_lane = None  # bound by VectorEngine.add_node
         return None  # no scalar Peer
 
+    @property
+    def _rate_limited(self) -> bool:
+        """Per-lane Config.max_in_mem_log_size enforcement: the arena is
+        this replica's in-memory log tier, and its tracked byte size gates
+        new proposals (the scalar core additionally aggregates follower
+        reports via RATE_LIMIT messages, cf. rate.go; lanes enforce the
+        bound locally — device lanes carry no payload bytes to report)."""
+        mx = self.config.max_in_mem_log_size
+        if not mx:
+            return False
+        lane = self._vec_lane
+        return lane is not None and lane.arena.unapplied_bytes > mx
+
+    @_rate_limited.setter
+    def _rate_limited(self, value) -> None:
+        # derived live from the lane arena; the base class's cached-flag
+        # writes (Node.__init__ / step_node) are meaningless here
+        pass
+
     # ------------------------------------------------------------ status
     def get_leader_id(self) -> int:
         lane = self._vec_lane
@@ -295,6 +315,59 @@ class VectorNode(Node):
         self.engine.recover_done(self)
 
 
+class _Arena(dict):
+    """Entry arena (real index -> Entry) that tracks its byte sizes, so
+    per-lane Config.max_in_mem_log_size enforcement costs O(1) at propose
+    time (cf. internal/server/rate.go + inmemory.go size accounting; the
+    arena is the vector engine's in-memory log tier).
+
+    Two counters: mem_bytes is everything resident; unapplied_bytes covers
+    only entries above the applied watermark — the real backpressure
+    signal, because applied entries stay in the arena merely as the device
+    window's payload cache (the scalar inmem drops them instead,
+    inmemory.go appliedLogTo)."""
+
+    __slots__ = ("mem_bytes", "unapplied_bytes", "applied")
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.mem_bytes = 0
+        self.unapplied_bytes = 0
+        self.applied = 0
+
+    def __setitem__(self, key, entry) -> None:
+        old = self.get(key)
+        sz = ENTRY_OVERHEAD_BYTES + len(entry.cmd)
+        if old is not None:
+            osz = ENTRY_OVERHEAD_BYTES + len(old.cmd)
+            self.mem_bytes -= osz
+            if key > self.applied:
+                self.unapplied_bytes -= osz
+        self.mem_bytes += sz
+        if key > self.applied:
+            self.unapplied_bytes += sz
+        super().__setitem__(key, entry)
+
+    def __delitem__(self, key) -> None:
+        old = self.get(key)
+        if old is not None:
+            sz = ENTRY_OVERHEAD_BYTES + len(old.cmd)
+            self.mem_bytes -= sz
+            if key > self.applied:
+                self.unapplied_bytes -= sz
+        super().__delitem__(key)
+
+    def mark_applied(self, index: int) -> None:
+        """Advance the applied watermark; entries in (applied, index] no
+        longer count toward unapplied_bytes. O(1) amortized per entry."""
+        for i in range(self.applied + 1, index + 1):
+            e = self.get(i)
+            if e is not None:
+                self.unapplied_bytes -= ENTRY_OVERHEAD_BYTES + len(e.cmd)
+        if index > self.applied:
+            self.applied = index
+
+
 class _Lane:
     """Per-group host bookkeeping owned by the engine loop thread. Protocol
     mirrors (term/role/leader/commit/last/first/base) live in the engine's
@@ -328,7 +401,7 @@ class _Lane:
         self.cfg: Config = node.config
         self.slots: Dict[int, int] = {}  # node_id -> slot
         self.rev: Dict[int, int] = {}  # slot -> node_id
-        self.arena: Dict[int, Entry] = {}  # real index -> Entry
+        self.arena: _Arena = _Arena()  # real index -> Entry, size-tracked
         self.staged_props: deque = deque()  # (Entry, is_local)
         self.staged_reads: deque = deque()  # RequestState
         self.staged_ccs: deque = deque()  # (Entry, key)
@@ -1279,6 +1352,8 @@ class VectorEngine:
                 )
             )
             self._m_applied_since[g] += len(ents)
+            # committed + dispatched to the RSM: no longer memory pressure
+            lane.arena.mark_applied(b + at)
             if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
                 lane.cc_inflight = False
             self.set_task_ready(lane.node.cluster_id)
@@ -1815,6 +1890,11 @@ class VectorEngine:
             if dev_first <= di <= dev_last:
                 ring_terms[di % W] = e.term
                 ring_cc[di % W] = e.type == EntryType.CONFIG_CHANGE
+        # arena holds nothing at or below the snapshot: seed the applied
+        # watermark there directly (no entries below it to discount) so
+        # the first phase-4 mark_applied walks the window, not the whole
+        # history from zero
+        lane.arena.applied = max(snap_index, lane.arena.applied)
         marker = dev_first - 1
         if marker == 0:
             marker_term = snap.term if snap_index and b == snap_index else 0
@@ -2109,7 +2189,11 @@ class VectorEngine:
             frozenset(mem.observers),
             frozenset(mem.witnesses),
         )
-        lane.arena = {}
+        lane.arena = _Arena()
+        # everything at or below the installed snapshot is applied; seeding
+        # the watermark keeps the next phase-4 mark_applied from walking
+        # the whole history from zero (same as the activation path)
+        lane.arena.applied = max(ss.index, 0)
         lane.catchup = {}
         lane.snap_inflight = {}
         self._catchups.discard(lane)

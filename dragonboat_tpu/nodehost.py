@@ -122,7 +122,9 @@ class NodeHost(IMessageHandler):
             cfg.raft_address,
             cfg.deployment_id,
             rpc_factory,
-            send_queue_length=cfg.max_send_queue_size or 0,
+            # max_send_queue_size is a BYTE bound (cf. NodeHostConfig in
+            # config.go); the count bound stays at the soft default
+            max_send_queue_bytes=cfg.max_send_queue_size or 0,
         )
         self.transport.set_message_handler(self)
         from .transport.chunks import Chunks  # lazy: needs snapshot dir root

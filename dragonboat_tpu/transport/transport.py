@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..core.rate import RateLimiter
 from ..raftio import IMessageHandler, IRaftRPC
 from ..settings import soft
 from ..types import Message, MessageBatch, MessageType
@@ -52,9 +53,40 @@ class _Breaker:
 
 
 class _SendQueue:
-    def __init__(self, maxlen: int) -> None:
+    """Per-target outbound queue: count-bounded by the queue itself and
+    byte-bounded by a RateLimiter when NodeHostConfig.max_send_queue_size
+    is set (cf. transport.go:170-185 sendQueueRateLimited — an unbounded
+    byte backlog toward one dead peer would otherwise hold entry payloads
+    alive indefinitely)."""
+
+    def __init__(self, maxlen: int, max_bytes: int = 0) -> None:
         self.q: "queue.Queue[Optional[Message]]" = queue.Queue(maxlen)
         self.thread: Optional[threading.Thread] = None
+        self.rl = RateLimiter(max_bytes)
+        # RateLimiter is not thread-safe; producer (engine threads) and
+        # consumer (queue worker) both touch it
+        self._rl_mu = threading.Lock()
+
+    def try_put(self, m: Message) -> bool:
+        # account BEFORE enqueueing: the consumer may dequeue and decrease
+        # the instant put_nowait returns, and a decrease-before-increase
+        # pair would clamp at 0 then leak the increase forever
+        sz = _msg_size(m)
+        with self._rl_mu:
+            if self.rl.enabled and self.rl.rate_limited():
+                return False
+            self.rl.increase(sz)
+        try:
+            self.q.put_nowait(m)
+        except queue.Full:
+            with self._rl_mu:
+                self.rl.decrease(sz)
+            return False
+        return True
+
+    def taken(self, m: Message) -> None:
+        with self._rl_mu:
+            self.rl.decrease(_msg_size(m))
 
 
 class Transport:
@@ -67,6 +99,7 @@ class Transport:
         rpc_factory: Callable[..., IRaftRPC],
         resolver: Optional[Nodes] = None,
         send_queue_length: int = 0,
+        max_send_queue_bytes: int = 0,
     ) -> None:
         self.source_address = source_address
         self.deployment_id = deployment_id
@@ -77,6 +110,7 @@ class Transport:
         self._mu = threading.Lock()
         self._stopped = threading.Event()
         self._qlen = send_queue_length or 1024
+        self._qbytes = max_send_queue_bytes
         self._metrics = {
             "sent": 0,
             "send_failures": 0,
@@ -163,11 +197,7 @@ class Transport:
         if not breaker.ready():
             return False
         sq = self._get_queue(addr)
-        try:
-            sq.q.put_nowait(m)
-        except queue.Full:
-            return False
-        return True
+        return sq.try_put(m)
 
     def _get_breaker(self, addr: str) -> _Breaker:
         with self._mu:
@@ -180,7 +210,7 @@ class Transport:
         with self._mu:
             sq = self._queues.get(addr)
             if sq is None:
-                sq = self._queues[addr] = _SendQueue(self._qlen)
+                sq = self._queues[addr] = _SendQueue(self._qlen, self._qbytes)
                 sq.thread = threading.Thread(
                     target=self._process_queue,
                     args=(addr, sq),
@@ -203,12 +233,8 @@ class Transport:
                     continue
                 if m is None:
                     return
-                batch = MessageBatch(
-                    requests=[m],
-                    deployment_id=self.deployment_id,
-                    source_address=self.source_address,
-                    bin_ver=BIN_VER,
-                )
+                sq.taken(m)
+                requests = [m]
                 size = _msg_size(m)
                 while size < soft.max_message_batch_size:
                     try:
@@ -217,31 +243,46 @@ class Transport:
                         break
                     if m2 is None:
                         return
-                    batch.requests.append(m2)
+                    sq.taken(m2)
+                    requests.append(m2)
                     size += _msg_size(m2)
-                if self._pre_send_batch_hook is not None:
-                    if not self._pre_send_batch_hook(batch):
-                        continue  # dropped by chaos hook
-                try:
-                    if conn is None:
-                        self._metrics["connect_attempts"] += 1
-                        conn = self.rpc.get_connection(addr)
-                    conn.send_message_batch(batch)
-                    breaker.success()
-                    self._metrics["sent"] += len(batch.requests)
-                except Exception:
-                    self._metrics["send_failures"] += len(batch.requests)
-                    self._metrics["connect_failures"] += 1
-                    if conn is not None:
-                        try:
-                            conn.close()
-                        except Exception:
-                            pass
-                        conn = None
-                    breaker.fail()
-                    self._notify_unreachable(addr)
-                    # drop queued traffic for the cooldown window
-                    time.sleep(0.05)
+                # the message that crossed the byte cap ships in a second
+                # batch so no single wire write exceeds the cap
+                # (cf. transport.go:533-541 twoBatch)
+                if size >= soft.max_message_batch_size and len(requests) > 1:
+                    splits = [requests[:-1], requests[-1:]]
+                else:
+                    splits = [requests]
+                for reqs in splits:
+                    batch = MessageBatch(
+                        requests=reqs,
+                        deployment_id=self.deployment_id,
+                        source_address=self.source_address,
+                        bin_ver=BIN_VER,
+                    )
+                    if self._pre_send_batch_hook is not None:
+                        if not self._pre_send_batch_hook(batch):
+                            continue  # dropped by chaos hook
+                    try:
+                        if conn is None:
+                            self._metrics["connect_attempts"] += 1
+                            conn = self.rpc.get_connection(addr)
+                        conn.send_message_batch(batch)
+                        breaker.success()
+                        self._metrics["sent"] += len(batch.requests)
+                    except Exception:
+                        self._metrics["send_failures"] += len(batch.requests)
+                        self._metrics["connect_failures"] += 1
+                        if conn is not None:
+                            try:
+                                conn.close()
+                            except Exception:
+                                pass
+                            conn = None
+                        breaker.fail()
+                        self._notify_unreachable(addr)
+                        # drop queued traffic for the cooldown window
+                        time.sleep(0.05)
         finally:
             if conn is not None:
                 try:

@@ -1,0 +1,232 @@
+"""Rate limiter tests (cf. internal/server/rate.go:32-137 and the
+reference's rate-limit flow raft.go:543-683, 1779-1785): limiter
+semantics, InMemory byte accounting, follower->leader reporting through
+RATE_LIMIT messages in the scalar core, and end-to-end ErrSystemBusy
+behavior on a NodeHost for BOTH engines."""
+import time
+
+import pytest
+
+from dragonboat_tpu.core.rate import (
+    ENTRY_OVERHEAD_BYTES,
+    RateLimiter,
+    entries_mem_size,
+)
+from dragonboat_tpu.types import Entry
+
+
+def _e(index: int, payload: bytes = b"") -> Entry:
+    return Entry(index=index, term=1, cmd=payload)
+
+
+class TestRateLimiter:
+    def test_disabled_when_unset(self):
+        rl = RateLimiter(0)
+        assert not rl.enabled
+        rl.set(1 << 40)
+        assert not rl.rate_limited()
+
+    def test_local_size_limits(self):
+        rl = RateLimiter(100)
+        assert rl.enabled
+        rl.set(100)
+        assert not rl.rate_limited()  # bound is exclusive
+        rl.increase(1)
+        assert rl.rate_limited()
+        rl.decrease(50)
+        assert not rl.rate_limited()
+
+    def test_follower_state_limits_leader(self):
+        rl = RateLimiter(100)
+        rl.set(10)
+        rl.set_follower_state(2, 500)
+        assert rl.rate_limited()
+        rl.set_follower_state(2, 20)
+        assert not rl.rate_limited()
+
+    def test_stale_follower_reports_age_out(self):
+        """A partitioned follower must not wedge the leader as limited
+        (rate.go:102-127 gc)."""
+        rl = RateLimiter(100)
+        rl.set_follower_state(2, 500)
+        assert rl.rate_limited()
+        for _ in range(RateLimiter.GC_TICK + 1):
+            rl.tick()
+        assert not rl.rate_limited()
+        # and the stale record is actually gone, not just ignored
+        assert not rl.rate_limited()
+
+    def test_reset_follower_state(self):
+        rl = RateLimiter(100)
+        rl.set_follower_state(2, 500)
+        rl.reset_follower_state()
+        assert not rl.rate_limited()
+
+
+class TestInMemoryByteTracking:
+    def _inmem(self, rl):
+        from dragonboat_tpu.core.logentry import InMemory
+
+        im = InMemory(0)
+        im.set_rate_limiter(rl)
+        return im
+
+    def test_merge_append_and_apply(self):
+        rl = RateLimiter(1 << 30)
+        im = self._inmem(rl)
+        im.merge([_e(1, b"x" * 10), _e(2, b"y" * 20)])
+        assert rl.get() == 2 * ENTRY_OVERHEAD_BYTES + 30
+        im.merge([_e(3, b"z" * 5)])
+        assert rl.get() == 3 * ENTRY_OVERHEAD_BYTES + 35
+        im.applied_log_to(2)  # new marker: entry 1 dropped, 2 and 3 kept
+        assert rl.get() == 2 * ENTRY_OVERHEAD_BYTES + 25
+        assert rl.get() == entries_mem_size(im.entries)
+
+    def test_merge_conflict_truncates_size(self):
+        rl = RateLimiter(1 << 30)
+        im = self._inmem(rl)
+        im.merge([_e(1, b"a" * 10), _e(2, b"b" * 10), _e(3, b"c" * 10)])
+        # conflicting suffix replaces entries >= 2
+        im.merge([_e(2, b"d" * 100)])
+        assert rl.get() == entries_mem_size(im.entries)
+        assert len(im.entries) == 2
+
+    def test_restore_resets_size(self):
+        from dragonboat_tpu.types import Snapshot
+
+        rl = RateLimiter(1 << 30)
+        im = self._inmem(rl)
+        im.merge([_e(1, b"a" * 10)])
+        im.restore(Snapshot(index=5, term=2))
+        assert rl.get() == 0
+
+
+class TestScalarCoreReporting:
+    """Follower -> leader RATE_LIMIT flow on the raft core harness."""
+
+    def _mk(self, max_bytes):
+        from tests.raft_harness import Network, new_test_raft
+
+        rafts = {
+            i: new_test_raft(i, [1, 2, 3], max_in_mem_log_size=max_bytes)
+            for i in (1, 2, 3)
+        }
+        return Network(rafts)
+
+    def test_follower_report_limits_leader(self):
+        from dragonboat_tpu.types import Message, MessageType as MT
+
+        net = self._mk(1000)
+        net.elect(1)
+        leader = net.rafts[1]
+        assert not leader.rl.rate_limited()
+        # follower 2 reports an oversized in-mem log directly (the wire
+        # path for the report message itself)
+        leader.handle(Message(type=MT.RATE_LIMIT, from_=2, to=1, hint=5000,
+                              term=leader.term))
+        assert leader.rl.rate_limited()
+        # leader ticks age the report out after GC_TICK limiter ticks
+        for _ in range(leader.election_timeout * (RateLimiter.GC_TICK + 1)):
+            leader.tick()
+        assert not leader.rl.rate_limited()
+
+    def test_follower_sends_report_when_over(self):
+        net = self._mk(200)
+        net.elect(1)
+        f = net.rafts[2]
+        # inflate the follower's tracked size past the bound
+        f.rl.set(10_000)
+        sent = []
+        for _ in range(f.election_timeout * 2):
+            f.tick()
+            sent.extend(m for m in f.msgs if m.type.name == "RATE_LIMIT")
+            f.msgs.clear()
+        assert sent, "follower never reported"
+        assert all(m.to == 1 for m in sent)
+        assert any(m.hint > 0 for m in sent)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ["scalar", "vector"])
+def test_nodehost_rate_limit_e2e(tmp_path, engine):
+    """A tiny max_in_mem_log_size makes a proposal burst hit
+    ErrSystemBusy, and the node accepts work again once drained."""
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.requests import (
+        ErrClusterNotReady,
+        ErrSystemBusy,
+        ErrTimeout,
+    )
+    from dragonboat_tpu.statemachine import IStateMachine, Result
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+    class SlowSM(IStateMachine):
+        def __init__(self, *a):
+            self.n = 0
+
+        def update(self, data):
+            time.sleep(0.002)  # keep entries in-mem long enough to pile up
+            self.n += 1
+            return Result(value=self.n)
+
+        def lookup(self, q):
+            return self.n
+
+        def save_snapshot(self, w, fc, done):
+            w.write(b"\0")
+
+        def recover_from_snapshot(self, r, fc, done):
+            r.read()
+
+        def close(self):
+            pass
+
+    reg = _Registry()
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=81, rtt_millisecond=5, raft_address="rl1:1",
+        nodehost_dir=str(tmp_path / "nh1"),
+        raft_rpc_factory=lambda l: loopback_factory(l, reg),
+        engine=EngineConfig(kind=engine, max_groups=4, max_peers=4,
+                            log_window=64),
+    ))
+    try:
+        nh.start_cluster(
+            {1: "rl1:1"}, False, lambda c, n: SlowSM(),
+            Config(cluster_id=1, node_id=1, election_rtt=20,
+                   heartbeat_rtt=2, max_in_mem_log_size=2048),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            lid, ok = nh.get_leader_id(1)
+            if ok:
+                break
+            time.sleep(0.02)
+        assert ok
+
+        s = nh.get_noop_session(1)
+        busy = False
+        inflight = []
+        for i in range(4000):
+            try:
+                inflight.append(nh.propose(s, b"p" * 256, 30.0))
+            except ErrSystemBusy:
+                busy = True
+                break
+        assert busy, "burst never tripped the rate limiter"
+
+        # drain, then the node must accept proposals again
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                r = nh.sync_propose(s, b"after", timeout_s=5.0)
+                if r is not None:
+                    break
+            except (ErrSystemBusy, ErrClusterNotReady, ErrTimeout):
+                # busy / transiently dropped mid-drain: retry like a real
+                # client
+                time.sleep(0.1)
+        else:
+            raise AssertionError("node never recovered from rate limit")
+    finally:
+        nh.stop()
