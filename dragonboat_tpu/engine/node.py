@@ -51,6 +51,7 @@ from ..types import (
 )
 from .quiesce import QuiesceManager
 from .queue import EntryQueue, MessageQueue, ReadIndexQueue
+from .snapshotstate import SnapshotState
 
 
 class Node:
@@ -115,14 +116,10 @@ class Node:
             # notifications start from it (cf. statemachine.go:374-389
             # OpenOnDiskStateMachine; node.go:553-583)
             self.sm.open()
-        # snapshot bookkeeping
+        # snapshot FSM: flags + one req/completed slot per kind
+        # (cf. snapshotstate.go:64-214)
+        self.ss = SnapshotState()
         self._applied_since_snapshot = 0
-        self._snapshot_lock = threading.Lock()
-        self._snapshot_in_progress = False
-        self._stream_requests: List = []
-        from collections import deque
-
-        self._snapshot_tasks: deque = deque()
         # launch the protocol core (VectorNode overrides: its protocol state
         # lives in the shared device tensors, not a per-group Peer)
         self.peer = self._launch_core(
@@ -223,6 +220,21 @@ class Node:
 
     def request_snapshot(self, req: SSRequest, timeout_ticks: int) -> RequestState:
         rs, req = self.pending_snapshot.request(req, timeout_ticks)
+        if self.ss.taking_snapshot():
+            # a save is already in flight (possibly an automatic one that
+            # registered no pending request): ignore rather than stack a
+            # second save behind it (cf. node.go reportIgnored path)
+            self.pending_snapshot.apply(0, ignored=True)
+            return rs
+        last_applied = self.sm.last_applied_index()
+        if not req.is_exported() and (
+            last_applied == self.ss.get_req_snapshot_index()
+        ):
+            # nothing applied since the last requested snapshot: ignore
+            # instead of writing an identical image (cf. node.go:1085-1091)
+            self.pending_snapshot.apply(0, ignored=True)
+            return rs
+        self.ss.set_req_snapshot_index(last_applied)
         self.push_take_snapshot_request(req)
         return rs
 
@@ -237,6 +249,9 @@ class Node:
         if self.stopped:
             return None
         with self._mu:
+            # finalize any completed snapshot save first: it may install a
+            # snapshot record / compact the log the step below reads
+            self._process_snapshot_status()
             last_applied = self.sm.last_applied_index()
             # applied cursor feeds campaign eligibility + entry pagination
             # (cf. node.go stepNode -> p.NotifyRaftLastApplied)
@@ -329,10 +344,14 @@ class Node:
 
     def _tick(self) -> None:
         self.clock.increase_tick()
-        self.pending_proposals.gc()
-        self.pending_read_indexes.gc()
-        self.pending_config_change.gc()
-        self.pending_snapshot.gc()
+        # one gate for ALL pendings sharing this clock: should_gc consumes
+        # the window, so gating inside each gc() would let the first
+        # starve the rest (reads/cc/snapshots would never time out)
+        if self.clock.should_gc():
+            self.pending_proposals.gc()
+            self.pending_read_indexes.gc()
+            self.pending_config_change.gc()
+            self.pending_snapshot.gc()
         if self.quiesce_mgr.tick():
             self.peer.quiesced_tick()
         else:
@@ -396,14 +415,27 @@ class Node:
     # ------------------------------------------------------- engine: applying
     def handle_task(self, batch, apply) -> bool:
         """Drain apply work on a task worker; returns True if a snapshot
-        task needs a snapshot worker (cf. node.go:795)."""
+        task needs a snapshot worker (cf. node.go:795). Snapshot tasks land
+        in the FSM's per-kind request slots (snapshotstate.go:143-161); a
+        task racing an occupied slot goes back to the task queue and
+        retries once the worker drains the slot."""
         st = self.sm.handle(batch, apply)
         if st is not None:
-            # queued, not a single slot: a save request arriving while a
-            # recover task is pending must not overwrite it (the reference
-            # keeps separate req/completed slots per kind,
-            # snapshotstate.go:64-214)
-            self._snapshot_tasks.append(st)
+            if st.snapshot_requested:
+                deposited = self.ss.save_req.set(st)
+            else:
+                deposited = self.ss.recover_req.set(st)
+                if deposited:
+                    # Replicate traffic is dropped while the SM rebuilds
+                    # (node.go:1199); flag from deposit, not worker pickup
+                    self.ss.set_recovering_from_snapshot()
+            if not deposited:
+                # requeue WITHOUT signalling: run_snapshot_work re-signals
+                # task_ready after draining the slot — self-signalling here
+                # would hot-spin the task worker for the whole in-flight
+                # snapshot
+                self.sm.task_queue.add(st)
+                return False
             self.engine.set_snapshot_ready(self.cluster_id)
             return True
         return False
@@ -458,16 +490,10 @@ class Node:
         self.sm.task_queue.add(t)
         self.engine.set_task_ready(self.cluster_id)
 
-    def _push_stream_snapshot_request(self, m: Message) -> None:
-        """Leader streams a snapshot to a lagging on-disk follower; regular
-        SMs send the latest snapshot file chunked (cf. nodehost.go:1724-1744)."""
-        with self._snapshot_lock:
-            self._stream_requests.append(m)
-        self.engine.set_snapshot_ready(self.cluster_id)
-
     def _snapshot_busy(self) -> bool:
-        with self._snapshot_lock:
-            return self._snapshot_in_progress
+        # taking OR recovering: both make concurrent Replicate application
+        # unsafe/worthless (cf. node.go:1199)
+        return self.ss.busy()
 
     def _save_snapshot_required(self, ud: Update) -> None:
         """Periodic snapshot trigger by applied-entry count
@@ -477,69 +503,112 @@ class Node:
             return
         if self._applied_since_snapshot < se:
             return
-        with self._snapshot_lock:
-            if self._snapshot_in_progress:
-                return
-            self._snapshot_in_progress = True
+        if self.ss.taking_snapshot():
+            return
+        self.ss.set_taking_snapshot()
         self._applied_since_snapshot = 0
         self.push_take_snapshot_request(SSRequest())
 
     def run_snapshot_work(self) -> None:
-        """Executed on a snapshot worker: take/recover/stream snapshots
-        (cf. execengine.go:227-335 snapshot worker mains)."""
-        while True:
-            try:
-                task = self._snapshot_tasks.popleft()
-            except IndexError:
-                break
-            if task.snapshot_requested:
-                self._do_save_snapshot(task.ss_request or SSRequest())
-            elif task.snapshot_available:
-                self._do_recover_snapshot(task)
-        with self._snapshot_lock:
-            streams, self._stream_requests = self._stream_requests, []
-        for m in streams:
-            self._do_stream_snapshot(m)
+        """Executed on a snapshot worker: drain the FSM's request slots and
+        any deferred log compaction (cf. execengine.go:227-335 snapshot
+        worker mains + snapshotstate.go req slots)."""
+        did = False
+        task, had = self.ss.save_req.take()
+        if had:
+            did = True
+            self._do_save_snapshot(task.ss_request or SSRequest())
+        task, had = self.ss.recover_req.take()
+        if had:
+            did = True
+            self._do_recover_snapshot(task)
+        if did:
+            # a snapshot task that raced the occupied slot sits requeued in
+            # the task queue; wake the task worker now that the slot drained
+            self.engine.set_task_ready(self.cluster_id)
+        compact_to = self.ss.get_compact_log_to()
+        if compact_to > 0:
+            # persistent-log compaction is disk IO: it runs HERE, not under
+            # the protocol lock where finalization queued it
+            # (cf. snapshotstate.go compactLogTo + node.go:849-867)
+            self.logdb.remove_entries_to(
+                self.cluster_id, self._node_id, compact_to
+            )
 
     def _do_save_snapshot(self, req: SSRequest) -> None:
+        """IO half of a save, on the snapshot worker; the result lands in
+        the save_completed slot and the step loop finalizes it under the
+        protocol lock (_process_snapshot_status) — log-reader mutations
+        from this thread would race concurrent steps."""
+        self.ss.set_taking_snapshot()
+        ss = None
+        failed = ignored = False
         try:
             if self.snapshotter is None:
-                self.pending_snapshot.apply(0, ignored=True)
-                return
-            ss, env = self.sm.save_snapshot(req)
-            self.snapshotter.commit(ss, req)
-            if not req.is_exported():
-                # exported snapshots leave the node's own history alone:
-                # no logdb record was written, so advancing the log
-                # reader / compacting here would delete entries the node
-                # still needs to replay (cf. nodehost.go exported path)
-                self.log_reader.create_snapshot(ss)
-                self._compact_log(ss, req)
-            self.pending_snapshot.apply(ss.index, ignored=False)
+                ignored = True
+            else:
+                ss, env = self.sm.save_snapshot(req)
+                self.snapshotter.commit(ss, req)
         except Exception:
-            self.pending_snapshot.apply(0, ignored=False, failed=True)
-        finally:
-            with self._snapshot_lock:
-                self._snapshot_in_progress = False
+            failed = True
+        self.ss.save_completed.put((ss, req, failed, ignored))
+        self._notify_snapshot_status()
+
+    def _notify_snapshot_status(self) -> None:
+        """Route completed snapshot work back to whichever loop owns this
+        node's protocol state (scalar: the step worker; vector override:
+        the engine loop)."""
+        self.engine.set_node_ready(self.cluster_id)
+
+    def _process_snapshot_status(self) -> None:
+        """Finalize completed snapshot work; caller holds the protocol
+        lock (cf. node.go processSaveStatus)."""
+        for t in self.ss.save_completed.take_all():
+            ss, req, failed, ignored = t
+            try:
+                if ignored or failed:
+                    self.pending_snapshot.apply(
+                        0, ignored=ignored, failed=failed
+                    )
+                    continue
+                if not req.is_exported():
+                    # exported snapshots leave the node's own history
+                    # alone: no logdb record was written, so advancing the
+                    # log reader / compacting here would delete entries
+                    # the node still needs to replay (cf. nodehost.go
+                    # exported path)
+                    self.log_reader.create_snapshot(ss)
+                    self._compact_log(ss, req)
+                self.ss.set_snapshot_index(ss.index)
+                self.pending_snapshot.apply(ss.index, ignored=False)
+            except Exception:
+                # a finalization fault (logdb/log-reader IO) must surface
+                # as a failed request, not a silent timeout
+                self.pending_snapshot.apply(0, ignored=False, failed=True)
+            finally:
+                self.ss.clear_taking_snapshot()
 
     def _do_recover_snapshot(self, task: Task) -> None:
-        idx = self.sm.recover_from_snapshot(task)
-        if idx > 0:
-            ss = self.snapshotter.get_most_recent_snapshot()
-            if ss is not None and not ss.is_empty():
-                with self._mu:
-                    self.log_reader.apply_snapshot(ss)
-                    self.peer.restore_remotes(ss)
-                    self.peer.notify_raft_last_applied(self.sm.last_applied_index())
-
-    def _do_stream_snapshot(self, m: Message) -> None:
-        if self.snapshotter is None:
-            return
-        self.snapshotter.stream_to(self, m)
+        try:
+            idx = self.sm.recover_from_snapshot(task)
+            if idx > 0:
+                ss = self.snapshotter.get_most_recent_snapshot()
+                if ss is not None and not ss.is_empty():
+                    with self._mu:
+                        self.log_reader.apply_snapshot(ss)
+                        self.peer.restore_remotes(ss)
+                        self.peer.notify_raft_last_applied(
+                            self.sm.last_applied_index()
+                        )
+        finally:
+            self.ss.clear_recovering_from_snapshot()
 
     def _compact_log(self, ss: Snapshot, req: SSRequest) -> None:
         """Keep compaction_overhead entries behind the snapshot
-        (cf. node.go:680-693 + 849-867)."""
+        (cf. node.go:680-693 + 849-867). Caller holds _mu — the in-memory
+        log-reader mutation must be exclusive with protocol steps; the
+        persistent-log removal is disk IO and is deferred to a snapshot
+        worker through compact_log_to."""
         overhead = (
             req.compaction_overhead
             if req is not None and req.override_compaction
@@ -551,11 +620,11 @@ class Node:
             return
         compact_to = ss.index - overhead
         try:
-            with self._mu:
-                self.log_reader.compact(compact_to)
+            self.log_reader.compact(compact_to)
         except ErrCompacted:
             return  # already compacted past this point: benign
-        self.logdb.remove_entries_to(self.cluster_id, self._node_id, compact_to)
+        self.ss.set_compact_log_to(compact_to)
+        self.engine.set_snapshot_ready(self.cluster_id)
 
     # ---------------------------------------------------------------- events
     def _make_raft_event_adapter(self):

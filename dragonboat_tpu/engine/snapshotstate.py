@@ -1,0 +1,195 @@
+"""Per-node snapshot coordination FSM.
+
+Counterpart of the reference's snapshotState (snapshotstate.go:64-214):
+activity flags (taking / recovering / streaming), one REQUEST slot per
+kind, a completion queue, and the snapshot/compaction indexes. The
+engine's snapshot workers perform the IO-heavy half and post completions;
+the step loop consumes them under the node's protocol lock
+(cf. node.go processSnapshotStatus), which is what makes log-reader
+mutations race-free against concurrent steps. Compaction of the
+persistent log is deferred back to a snapshot worker through
+compact_log_to (snapshotstate.go:131-141) so disk IO never runs under
+the protocol lock.
+
+Slot discipline (cf. snapshotTask snapshotstate.go:28-62): a REQUEST slot
+holds at most one task; set() reports a collision and the caller requeues
+(the reference panics because its gating guarantees single-occupancy).
+Completions ride a small FIFO instead of the reference's one slot: a
+second save can finish before the step loop finalizes the first, and a
+single slot would silently drop one.
+
+Divergences from snapshotstate.go: stream request/completed slots do not
+exist here — snapshot streaming rides the transport's SnapshotLane
+(nodehost._async_send_snapshot), which reports through the streaming
+counter below; recovery completes inline on the snapshot worker (it
+already takes the protocol lock), so no recover-completed slot either.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional, Tuple
+
+
+class TaskSlot:
+    """One-slot task mailbox."""
+
+    __slots__ = ("_mu", "_task", "_has")
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._task = None
+        self._has = False
+
+    def set(self, task) -> bool:
+        """Deposit a task; False when the slot is already occupied."""
+        with self._mu:
+            if self._has:
+                return False
+            self._task = task
+            self._has = True
+            return True
+
+    def take(self) -> Tuple[object, bool]:
+        """Remove and return (task, had_task)."""
+        with self._mu:
+            task, had = self._task, self._has
+            self._task = None
+            self._has = False
+            return task, had
+
+    def occupied(self) -> bool:
+        with self._mu:
+            return self._has
+
+
+class TaskQueue:
+    """Small FIFO for completion records."""
+
+    __slots__ = ("_mu", "_q")
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._q: deque = deque()
+
+    def put(self, task) -> None:
+        with self._mu:
+            self._q.append(task)
+
+    def take_all(self) -> List:
+        with self._mu:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def occupied(self) -> bool:
+        with self._mu:
+            return bool(self._q)
+
+
+class SnapshotState:
+    """cf. snapshotstate.go:64-214."""
+
+    __slots__ = (
+        "_mu",
+        "_taking",
+        "_recovering",
+        "_streams",
+        "_snapshot_index",
+        "_req_snapshot_index",
+        "_compact_log_to",
+        "save_req",
+        "recover_req",
+        "save_completed",
+    )
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._taking = False
+        self._recovering = False
+        self._streams = 0
+        self._snapshot_index = 0
+        self._req_snapshot_index = 0
+        self._compact_log_to = 0
+        self.save_req = TaskSlot()
+        self.recover_req = TaskSlot()
+        self.save_completed = TaskQueue()
+
+    # ------------------------------------------------------------- flags
+    def taking_snapshot(self) -> bool:
+        with self._mu:
+            return self._taking
+
+    def set_taking_snapshot(self) -> None:
+        with self._mu:
+            self._taking = True
+
+    def clear_taking_snapshot(self) -> None:
+        with self._mu:
+            self._taking = False
+
+    def recovering_from_snapshot(self) -> bool:
+        with self._mu:
+            return self._recovering
+
+    def set_recovering_from_snapshot(self) -> None:
+        with self._mu:
+            self._recovering = True
+
+    def clear_recovering_from_snapshot(self) -> None:
+        with self._mu:
+            self._recovering = False
+
+    # streaming is a counter, not a boolean: several transport lanes can
+    # stream this node's snapshots to different peers at once
+    def streaming_snapshot(self) -> bool:
+        with self._mu:
+            return self._streams > 0
+
+    def begin_stream(self) -> None:
+        with self._mu:
+            self._streams += 1
+
+    def end_stream(self) -> None:
+        with self._mu:
+            self._streams = max(0, self._streams - 1)
+
+    def busy(self) -> bool:
+        with self._mu:
+            return self._taking or self._recovering
+
+    # ----------------------------------------------------------- indexes
+    def set_snapshot_index(self, index: int) -> None:
+        with self._mu:
+            self._snapshot_index = index
+
+    def get_snapshot_index(self) -> int:
+        with self._mu:
+            return self._snapshot_index
+
+    def set_req_snapshot_index(self, index: int) -> None:
+        with self._mu:
+            self._req_snapshot_index = index
+
+    def get_req_snapshot_index(self) -> int:
+        with self._mu:
+            return self._req_snapshot_index
+
+    def set_compact_log_to(self, index: int) -> None:
+        with self._mu:
+            self._compact_log_to = max(self._compact_log_to, index)
+
+    def get_compact_log_to(self) -> int:
+        """Swap-read: returns the pending compaction point and clears it
+        (cf. snapshotstate.go:135-137 atomic.SwapUint64)."""
+        with self._mu:
+            v = self._compact_log_to
+            self._compact_log_to = 0
+            return v
+
+    def has_compact_log_to(self) -> bool:
+        with self._mu:
+            return self._compact_log_to > 0
+
+
+__all__ = ["SnapshotState", "TaskSlot", "TaskQueue"]

@@ -43,8 +43,12 @@ class FileCollection(ISnapshotFileCollection):
             SnapshotFile(file_id=file_id, filepath=path, metadata=metadata)
         )
 
-    def finalize(self) -> List:
-        """Hard-link/copy external files into the snapshot dir."""
+    def finalize(self, record_dir: Optional[str] = None) -> List:
+        """Hard-link/copy external files into the snapshot dir. The files
+        land in self._dir (the crash-safe .generating temp dir), but the
+        RECORDED paths must point at record_dir — the final directory the
+        temp dir is renamed to on commit — or every later load would chase
+        a path that no longer exists."""
         out = []
         from ..types import SnapshotFile as WireFile
 
@@ -57,7 +61,7 @@ class FileCollection(ISnapshotFileCollection):
                 shutil.copy2(f.filepath, dst)
             out.append(
                 WireFile(
-                    filepath=dst,
+                    filepath=os.path.join(record_dir or self._dir, name),
                     file_size=os.path.getsize(dst),
                     file_id=f.file_id,
                     metadata=f.metadata,
@@ -120,7 +124,7 @@ class Snapshotter:
             w.close()
             f.flush()
             os.fsync(f.fileno())
-        wire_files = files.finalize()
+        wire_files = files.finalize(record_dir=self._final_dir(index))
         ss = Snapshot(
             filepath=os.path.join(self._final_dir(index), fname),
             file_size=os.path.getsize(fpath),
@@ -227,13 +231,6 @@ class Snapshotter:
         except Exception:
             sink.abort()
             raise
-
-    def stream_to(self, node, m) -> None:
-        """Send the snapshot referenced by an InstallSnapshot message to the
-        target (chunked); installed by the transport snapshot subsystem."""
-        from ..transport.snapshotstream import stream_snapshot_to  # lazy
-
-        stream_snapshot_to(node, m)
 
     # ------------------------------------------------------------- retention
     def compact(self, latest_index: int) -> None:

@@ -187,13 +187,8 @@ def _make_activate_fn(cfg: KernelConfig, n: int):
 
 class _SharedClock(LogicalClock):
     """One logical clock shared by every lane of a VectorEngine. The engine
-    loop controls the gc cadence (it runs the pending-queue gc pass itself,
-    only for lanes with outstanding requests), so the per-clock should_gc
-    throttle is disabled — with dozens of Pending* objects sharing one
-    clock, the first caller would otherwise starve the rest."""
-
-    def should_gc(self) -> bool:  # pragma: no cover - trivial
-        return True
+    loop gates the pending-queue gc pass with ONE should_gc() check per
+    window (see _run_gc) — Pending*.gc() itself sweeps unconditionally."""
 
 
 class VectorNode(Node):
@@ -304,15 +299,23 @@ class VectorNode(Node):
         """InstallSnapshot arrived and the SM recovered from it on a
         snapshot worker; reconcile the device lane and ack the leader
         (cf. node.go:950-965 + raft.go handleInstallSnapshotMessage)."""
-        idx = self.sm.recover_from_snapshot(task)
-        if idx > 0:
-            ss = self.snapshotter.get_most_recent_snapshot()
-            if ss is not None and not ss.is_empty():
-                with self._mu:
-                    self.log_reader.apply_snapshot(ss)
-                self.engine.snapshot_restored(self, ss)
-                return
-        self.engine.recover_done(self)
+        try:
+            idx = self.sm.recover_from_snapshot(task)
+            if idx > 0:
+                ss = self.snapshotter.get_most_recent_snapshot()
+                if ss is not None and not ss.is_empty():
+                    with self._mu:
+                        self.log_reader.apply_snapshot(ss)
+                    self.engine.snapshot_restored(self, ss)
+                    return
+            self.engine.recover_done(self)
+        finally:
+            self.ss.clear_recovering_from_snapshot()
+
+    def _notify_snapshot_status(self) -> None:
+        # the engine loop owns this lane's protocol state (incl. the log
+        # reader the finalization mutates): route completions there
+        self.engine.snapshot_status_ready(self)
 
 
 class _Arena(dict):
@@ -540,6 +543,11 @@ class VectorEngine:
         self._carry: Set[_Lane] = set()  # lanes with leftover staged work
         self._catchups: Set[_Lane] = set()  # lanes replaying host log
         self._snapfb: Set[_Lane] = set()  # lanes with in-flight snapshots
+        # nodes with completed snapshot work awaiting finalization on this
+        # loop (cf. node.go processSaveStatus; scalar nodes do this in
+        # step_node)
+        self._snap_status: Set[VectorNode] = set()
+        self._snap_status_mu = threading.Lock()
         self._alloc_buffers()
         self._alloc_mirrors()
         # worker pools for apply + snapshot work (same split as ExecEngine)
@@ -693,8 +701,18 @@ class VectorEngine:
 
                 traceback.print_exc()
 
+    def snapshot_status_ready(self, node) -> None:
+        with self._snap_status_mu:
+            self._snap_status.add(node)
+        self._ready.set()
+
     def _run_once(self) -> None:
         self._apply_reconciles()
+        with self._snap_status_mu:
+            snap_done, self._snap_status = self._snap_status, set()
+        for node in snap_done:
+            with node._mu:
+                node._process_snapshot_status()
         with self._dirty_mu:
             dirty = self._dirty
             self._dirty = set()
@@ -758,6 +776,8 @@ class VectorEngine:
         """Request-timeout pass over lanes with outstanding requests only
         (the reference runs four gc calls per node per tick; idle lanes
         here cost nothing)."""
+        if not self.clock.should_gc():
+            return
         drop = []
         for cid in gc_cids:
             with self._lanes_mu:

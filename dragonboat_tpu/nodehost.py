@@ -562,8 +562,15 @@ class NodeHost(IMessageHandler):
         if addr is None:
             self._report_snapshot_status(m.cluster_id, m.to, True)
             return
+        try:
+            ss_state = self._get_node(m.cluster_id).ss
+            ss_state.begin_stream()
+        except Exception:
+            ss_state = None
 
         def on_done(cluster_id: int, to: int, failed: bool) -> None:
+            if ss_state is not None:
+                ss_state.end_stream()
             self._report_snapshot_status(cluster_id, to, failed)
 
         lane = SnapshotLane(

@@ -115,6 +115,12 @@ class RequestResult:
 # the per-request fast path (no callback registered) stays lock-free
 _cb_fire_mu = threading.Lock()
 
+# sticky proposal-shard assignment per client thread (see
+# PendingProposal.propose); module-level so every node's registry spreads
+# the same way
+_shard_tls = threading.local()
+_shard_rr = itertools.count()
+
 
 class RequestState:
     """One in-flight request (cf. requests.go:267-329). wait() blocks the
@@ -188,17 +194,20 @@ class LogicalClock:
         return False
 
 
-class PendingProposal:
-    """Keyed in-flight proposals (cf. proposalShard requests.go:983-1133;
-    the reference shards 16-ways to cut mutex contention — under the GIL a
-    single dict+lock serves the same role)."""
+class _ProposalShard:
+    """Keyed in-flight proposals, one lock's worth
+    (cf. proposalShard requests.go:983-1133)."""
 
-    def __init__(self, clock: LogicalClock) -> None:
+    def __init__(self, clock: LogicalClock, offset: int = 0,
+                 stride: int = 1) -> None:
         self._mu = threading.Lock()
         self._pending: Dict[int, RequestState] = {}
         self._clock = clock
+        # keys from this shard are ≡ offset (mod stride), so completions
+        # route back by key alone; the random base has its low 16 bits
+        # clear, keeping the congruence intact
         self._key_seq = itertools.count(
-            int.from_bytes(os.urandom(6), "big") << 16
+            (int.from_bytes(os.urandom(6), "big") << 16) + offset, stride
         )
         self.stopped = False
 
@@ -255,8 +264,10 @@ class PendingProposal:
             rs.notify(RequestResult(code=REQUEST_TERMINATED))
 
     def gc(self) -> None:
-        if not self._clock.should_gc():
-            return
+        """Sweep expired requests. Unconditional: the caller owns the
+        cadence (one should_gc() check per clock window covers every
+        Pending* sharing that clock — gating here let the first callee
+        consume the window and starve the rest)."""
         now = self._clock.tick
         with self._mu:
             expired = [k for k, rs in self._pending.items() if rs.deadline < now]
@@ -266,6 +277,59 @@ class PendingProposal:
 
     def has_pending(self) -> bool:
         return bool(self._pending)
+
+
+class PendingProposal:
+    """Sharded in-flight proposal registry (cf. pendingProposal
+    requests.go:903-981: 16 shards keyed by random key to cut mutex
+    contention). Even under the GIL the single proposal lock is contended
+    — every client thread and the engine's apply path serialize on it —
+    so proposals shard by submitting thread and completions route back by
+    key congruence (shard i issues keys ≡ i mod SHARDS)."""
+
+    SHARDS = 8
+
+    def __init__(self, clock: LogicalClock) -> None:
+        self._shards = [
+            _ProposalShard(clock, offset=i, stride=self.SHARDS)
+            for i in range(self.SHARDS)
+        ]
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> Tuple[RequestState, Entry]:
+        # thread affinity: each client thread gets a sticky shard index
+        # (round-robin at first use — thread idents are pointer-aligned,
+        # so ident % SHARDS would collide), keeping concurrent submitters
+        # on different locks with no per-propose shared routing state
+        idx = getattr(_shard_tls, "idx", None)
+        if idx is None:
+            idx = _shard_tls.idx = next(_shard_rr)
+        return self._shards[idx % self.SHARDS].propose(
+            session, cmd, timeout_ticks
+        )
+
+    def applied(
+        self, key: int, client_id: int, series_id: int, result: Result,
+        rejected: bool,
+    ) -> None:
+        self._shards[key % self.SHARDS].applied(
+            key, client_id, series_id, result, rejected
+        )
+
+    def dropped(self, key: int) -> None:
+        self._shards[key % self.SHARDS].dropped(key)
+
+    def close(self) -> None:
+        for s in self._shards:
+            s.close()
+
+    def gc(self) -> None:
+        for s in self._shards:
+            s.gc()
+
+    def has_pending(self) -> bool:
+        return any(s.has_pending() for s in self._shards)
 
 
 class PendingReadIndex:
@@ -382,8 +446,10 @@ class PendingReadIndex:
             rs.notify(RequestResult(code=REQUEST_TERMINATED))
 
     def gc(self) -> None:
-        if not self._clock.should_gc():
-            return
+        """Sweep expired requests. Unconditional: the caller owns the
+        cadence (one should_gc() check per clock window covers every
+        Pending* sharing that clock — gating here let the first callee
+        consume the window and starve the rest)."""
         now = self._clock.tick
         expired: List[RequestState] = []
         with self._mu:
@@ -448,8 +514,10 @@ class _SingleSlotPending:
             rs.notify(RequestResult(code=REQUEST_TERMINATED))
 
     def gc(self) -> None:
-        if not self._clock.should_gc():
-            return
+        """Sweep expired requests. Unconditional: the caller owns the
+        cadence (one should_gc() check per clock window covers every
+        Pending* sharing that clock — gating here let the first callee
+        consume the window and starve the rest)."""
         now = self._clock.tick
         with self._mu:
             rs = self._pending

@@ -186,7 +186,8 @@ int main(int argc, char** argv) {
 
     // --- snapshot on demand
     uint64_t snap_index = 0;
-    st = nh.SyncRequestSnapshot(kCluster, "", 10.0, &snap_index);
+    // generous: snapshot IO competes with the whole suite on 1-cpu CI
+    st = nh.SyncRequestSnapshot(kCluster, "", 60.0, &snap_index);
     if (!st.OK() || snap_index == 0) return fail("snapshot", st);
 
     // --- NodeHost info

@@ -18,3 +18,21 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration/chaos tests"
     )
+
+
+# ---- hang diagnosis (the Python half of the race-detection story; see
+# SURVEY §5: no -race exists for Python, so concurrency bugs here surface
+# as deadlocks/stalls under the chaos + differential suites) ----
+# If any single test wedges for 10 minutes, dump every thread's stack so
+# the lock cycle is visible in CI output instead of an opaque timeout.
+import faulthandler  # noqa: E402
+
+_HANG_DUMP_S = 600
+
+
+def pytest_runtest_setup(item):
+    faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=False)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    faulthandler.cancel_dump_traceback_later()

@@ -237,5 +237,15 @@ def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
         "linearizability violation in recorded history"
     )
 
+    # invariant 4: persisted logs obey Log Matching below the common
+    # commit point (cf. the reference monkeytest's logdb cross-check)
+    from dragonboat_tpu.tools.logdbcheck import check_logdb_consistency
+
+    report = check_logdb_consistency(
+        {nid: hosts[nid].logdb for nid in HOSTS}, CLUSTER
+    )
+    assert report.ok, f"logdb consistency violations: {report.violations}"
+    assert len(report.replicas) == len(HOSTS)
+
     for nh in hosts.values():
         nh.stop()

@@ -79,7 +79,10 @@ def group_config(cluster_id, node_id, **kw):
     )
 
 
-def wait_for(pred, timeout=10.0):
+def wait_for(pred, timeout=30.0):
+    # default must comfortably cover the vector engine's cold kernel
+    # compile (~10s on a busy 1-cpu box): elections cannot complete until
+    # the first step_fn compilation returns
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():

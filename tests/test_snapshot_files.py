@@ -1,0 +1,196 @@
+"""External snapshot files end-to-end (cf. statemachine/files.go +
+the reference's snapshot chunk file_info transfer): an SM adds an external
+file during save_snapshot; the file must survive (a) local restart
+recovery and (b) network snapshot install on a lagging peer, arriving in
+the peer's snapshot dir with its metadata."""
+import os
+import time
+
+import pytest
+
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+CLUSTER = 1
+
+
+class ExtFileSM(IStateMachine):
+    """Counter SM whose snapshot payload rides an EXTERNAL file: the main
+    stream holds only the count; the values live in ext-file records."""
+
+    def __init__(self, workdir):
+        self.workdir = workdir
+        self.values = []
+        self.recovered_meta = b""
+
+    def update(self, data):
+        self.values.append(data.decode())
+        return Result(value=len(self.values))
+
+    def lookup(self, q):
+        if q == b"meta":
+            return self.recovered_meta
+        return "|".join(self.values).encode()
+
+    def save_snapshot(self, w, files, done):
+        path = os.path.join(self.workdir, f"ext-{id(self)}-{len(self.values)}.dat")
+        with open(path, "w") as f:
+            f.write("|".join(self.values))
+        files.add_file(7, path, b"ext-meta-v1")
+        w.write(len(self.values).to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        n = int.from_bytes(r.read(8), "little")
+        assert len(files) == 1, f"expected 1 external file, got {files!r}"
+        f = files[0]
+        assert f.file_id == 7
+        self.recovered_meta = f.metadata
+        with open(f.filepath) as fh:
+            blob = fh.read()
+        self.values = blob.split("|") if blob else []
+        assert len(self.values) == n
+
+    def close(self):
+        pass
+
+
+def _mk(nid, reg, tmp, restart=False):
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=61, rtt_millisecond=5,
+        nodehost_dir=f"{tmp}/h{nid}", raft_address=f"x{nid}:1",
+        raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+        engine=EngineConfig(kind="vector", max_groups=8, max_peers=4,
+                            log_window=32),
+    ))
+    members = {1: "x1:1", 2: "x2:1", 3: "x3:1"}
+    nh.start_cluster(
+        {} if restart else members, False,
+        lambda c, n, tmp=tmp: ExtFileSM(str(tmp)),
+        Config(cluster_id=CLUSTER, node_id=nid, election_rtt=20,
+               heartbeat_rtt=2, snapshot_entries=20, compaction_overhead=3),
+    )
+    return nh
+
+
+def _wait_leader(hosts, deadline_s=60):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for nid, nh in hosts.items():
+            if nh is None:
+                continue
+            lid, ok = nh.get_leader_id(CLUSTER)
+            if ok and lid in hosts and hosts[lid] is not None:
+                return lid
+        time.sleep(0.02)
+    return None
+
+
+@pytest.mark.slow
+def test_external_files_transfer_on_install(tmp_path):
+    reg = _Registry()
+    hosts = {nid: _mk(nid, reg, tmp_path) for nid in (1, 2, 3)}
+    try:
+        leader = _wait_leader(hosts)
+        assert leader
+
+        # stop host 3, then commit far past the snapshot+compaction point
+        # so its catch-up NEEDS a snapshot install
+        hosts[3].stop()
+        hosts[3] = None
+        leader = _wait_leader(hosts)
+        assert leader
+        s = hosts[leader].get_noop_session(CLUSTER)
+        committed = 0
+        deadline = time.time() + 120
+        while committed < 80 and time.time() < deadline:
+            try:
+                hosts[leader].sync_propose(
+                    s, f"w{committed}".encode(), timeout_s=5.0)
+                committed += 1
+            except Exception:
+                leader = _wait_leader(hosts)
+                s = hosts[leader].get_noop_session(CLUSTER)
+        assert committed >= 80
+
+        # restart host 3: replays its short log, then the leader installs
+        # a snapshot carrying the external file
+        hosts[3] = _mk(3, reg, tmp_path, restart=True)
+        deadline = time.time() + 90
+        value = None
+        while time.time() < deadline:
+            try:
+                v = hosts[3].stale_read(CLUSTER, b"")
+                if v is not None and f"w{committed - 1}" in v.decode():
+                    value = v
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert value is not None, "lagging host never caught up via install"
+        # the external file's metadata went through recover on host 3
+        meta = hosts[3].stale_read(CLUSTER, b"meta")
+        assert meta == b"ext-meta-v1"
+        # and the received external file landed under host 3's snapshot dir
+        snapdir = hosts[3].snapshot_dir_root()
+        found = []
+        for root, _dirs, names in os.walk(snapdir):
+            found += [os.path.join(root, n) for n in names
+                      if n.startswith("external-file-")]
+        assert found, "no received external file on the installed host"
+    finally:
+        for nh in hosts.values():
+            if nh is not None:
+                nh.stop()
+
+
+def test_external_files_survive_local_restart(tmp_path):
+    """Restart recovery from a local snapshot must hand the SM its
+    external files too."""
+    reg = _Registry()
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=62, rtt_millisecond=5,
+        nodehost_dir=f"{tmp_path}/solo", raft_address="solo:1",
+        raft_rpc_factory=lambda l: loopback_factory(l, reg),
+    ))
+    nh.start_cluster(
+        {1: "solo:1"}, False, lambda c, n: ExtFileSM(str(tmp_path)),
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
+               heartbeat_rtt=2),
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        _, ok = nh.get_leader_id(CLUSTER)
+        if ok:
+            break
+        time.sleep(0.02)
+    s = nh.get_noop_session(CLUSTER)
+    for i in range(5):
+        nh.sync_propose(s, f"v{i}".encode(), timeout_s=5.0)
+    assert nh.sync_request_snapshot(CLUSTER, timeout_s=15.0) > 0
+    nh.stop()
+
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=62, rtt_millisecond=5,
+        nodehost_dir=f"{tmp_path}/solo", raft_address="solo:1",
+        raft_rpc_factory=lambda l: loopback_factory(l, reg),
+    ))
+    nh.start_cluster(
+        {}, False, lambda c, n: ExtFileSM(str(tmp_path)),
+        Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
+               heartbeat_rtt=2),
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if nh.stale_read(CLUSTER, b"meta") == b"ext-meta-v1":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        assert nh.stale_read(CLUSTER, b"meta") == b"ext-meta-v1"
+        assert b"v4" in nh.stale_read(CLUSTER, b"")
+    finally:
+        nh.stop()

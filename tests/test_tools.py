@@ -204,3 +204,45 @@ def test_request_snapshot_bad_export_path(tmp_path):
             nh.request_snapshot(CLUSTER, export_path=str(tmp_path / "missing"))
     finally:
         nh.stop()
+
+
+def test_logdb_checker_accepts_replicas_and_detects_divergence():
+    """The logdb consistency checker passes identical replica logs and
+    flags a committed-range divergence / commit-beyond-log violation
+    (Log Matching, raft paper 5.3)."""
+    from dragonboat_tpu.storage.kv import MemKV
+    from dragonboat_tpu.storage.logdb import ShardedLogDB
+    from dragonboat_tpu.tools.logdbcheck import check_logdb_consistency
+    from dragonboat_tpu.types import Entry, State, Update
+
+    def mk_db(node_id, cmds, commit, divergent_at=None):
+        db = ShardedLogDB(kv_factory=lambda shard: MemKV())
+        ents = []
+        for i, cmd in enumerate(cmds, start=1):
+            term = 2 if (divergent_at is not None and i >= divergent_at) else 1
+            ents.append(Entry(index=i, term=term, cmd=cmd))
+        db.save_raft_state([
+            Update(
+                cluster_id=CLUSTER, node_id=node_id,
+                state=State(term=2, vote=1, commit=commit),
+                entries_to_save=ents,
+            )
+        ])
+        return db
+
+    cmds = [f"c{i}".encode() for i in range(1, 8)]
+    dbs = {nid: mk_db(nid, cmds, commit=7) for nid in (1, 2)}
+    report = check_logdb_consistency(dbs, CLUSTER)
+    assert report.ok, report.violations
+    assert len(report.replicas) == 2
+
+    # replica 3 diverges at index 5 while both claim commit=7: violation
+    dbs[3] = mk_db(3, cmds, commit=7, divergent_at=5)
+    report = check_logdb_consistency(dbs, CLUSTER)
+    assert not report.ok
+    assert any("divergence" in v for v in report.violations)
+
+    # commit beyond the persisted log is a per-replica violation
+    dbs2 = {1: mk_db(1, cmds, commit=99)}
+    report = check_logdb_consistency(dbs2, CLUSTER)
+    assert any("beyond last persisted" in v for v in report.violations)
