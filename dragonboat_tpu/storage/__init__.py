@@ -3,11 +3,14 @@
 from .kv import IKVStore, MemKV, WalKV, WriteBatch
 from .logdb import ShardedLogDB
 from .logreader import LogReader
+from .sqlite_kv import SqliteKV, sqlite_logdb_factory
 
 __all__ = [
     "IKVStore",
     "MemKV",
     "WalKV",
+    "SqliteKV",
+    "sqlite_logdb_factory",
     "WriteBatch",
     "ShardedLogDB",
     "LogReader",

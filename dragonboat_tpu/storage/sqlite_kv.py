@@ -1,0 +1,142 @@
+"""SQLite-backed IKVStore: the B-tree alternative storage backend.
+
+Counterpart of the reference's pluggable LogDB backends
+(plugin/{rocksdb,leveldb,pebble} over internal/logdb/kv/kv.go:28-74): the
+same ordered-KV contract on a second, structurally different engine.
+WalKV is a log-structured WAL + table; this backend is a B-tree with its
+own write-ahead journal (sqlite WAL mode), giving O(log n) ordered range
+scans without replay and cheap range deletes — the trade the reference
+makes when it picks RocksDB/Pebble over a plain WAL.
+
+Durability: every commit_write_batch is one sqlite transaction with
+`synchronous=FULL`, so the batch is fsynced before the call returns —
+the same discipline save_raft_state requires of WalKV.
+
+Select it per NodeHost with
+    NodeHostConfig(logdb_factory=sqlite_logdb_factory)
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Callable, Optional
+
+from .kv import IKVStore, WriteBatch, _OP_DEL, _OP_PUT, _OP_RANGE_DEL
+
+
+class SqliteKV(IKVStore):
+    """Ordered KV on one sqlite database file (bytes keys, BLOB order ==
+    lexicographic byte order, matching the key schema's big-endian ids)."""
+
+    def __init__(self, dirname: str) -> None:
+        os.makedirs(dirname, exist_ok=True)
+        self._path = os.path.join(dirname, "logdb.sqlite")
+        # one connection guarded by one lock: the LogDB shard above this
+        # already serializes writers, readers are short point/range scans
+        self._mu = threading.RLock()
+        self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=FULL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+        )
+        self._conn.commit()
+
+    def name(self) -> str:
+        return "sqlite"
+
+    def close(self) -> None:
+        with self._mu:
+            if self._conn is not None:
+                self._conn.commit()
+                self._conn.close()
+                self._conn = None
+
+    def get_value(self, key: bytes) -> Optional[bytes]:
+        with self._mu:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (key,)
+            ).fetchone()
+        return bytes(row[0]) if row is not None else None
+
+    def iterate_value(
+        self,
+        fk: bytes,
+        lk: bytes,
+        inc_last: bool,
+        op: Callable[[bytes, bytes], bool],
+    ) -> None:
+        cmp = "<=" if inc_last else "<"
+        with self._mu:
+            # row-at-a-time: op returning False must stop the scan without
+            # materializing the rest of the range (LogDB's size-budgeted
+            # reads depend on this)
+            cur = self._conn.execute(
+                f"SELECT k, v FROM kv WHERE k >= ? AND k {cmp} ? ORDER BY k",
+                (fk, lk),
+            )
+            for k, v in cur:
+                if not op(bytes(k), bytes(v)):
+                    return
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        with self._mu:
+            try:
+                cur = self._conn.cursor()
+                for opcode, k, v in wb.ops:
+                    if opcode == _OP_PUT:
+                        cur.execute(
+                            "INSERT INTO kv (k, v) VALUES (?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                            (k, v),
+                        )
+                    elif opcode == _OP_DEL:
+                        cur.execute("DELETE FROM kv WHERE k = ?", (k,))
+                    elif opcode == _OP_RANGE_DEL:
+                        cur.execute(
+                            "DELETE FROM kv WHERE k >= ? AND k < ?", (k, v)
+                        )
+                self._conn.commit()  # one fsynced transaction per batch
+            except Exception:
+                # a half-applied batch must NOT linger in the implicit
+                # transaction (the next unrelated commit would persist a
+                # torn raft state); roll back and surface the error
+                self._conn.rollback()
+                raise
+
+    def bulk_remove_entries(self, fk: bytes, lk: bytes) -> None:
+        with self._mu:
+            self._conn.execute(
+                "DELETE FROM kv WHERE k >= ? AND k < ?", (fk, lk)
+            )
+            self._conn.commit()
+
+    def compact_entries(self, fk: bytes, lk: bytes) -> None:
+        # B-tree pages free incrementally; nothing to rewrite
+        return None
+
+    def full_compaction(self) -> None:
+        with self._mu:
+            self._conn.execute("VACUUM")
+            self._conn.commit()
+
+
+def sqlite_logdb_factory(dirname: str, **kw):
+    """NodeHostConfig.logdb_factory for the sqlite backend
+    (cf. config.go LogDBFactory + plugin/pebble.go). NodeHost hands the
+    factory its ROOT dir; namespace under it like the default backend
+    does, so shard dirs never scatter beside the LOCK file and snapshot
+    dirs."""
+    import os
+
+    from .logdb import ShardedLogDB
+
+    return ShardedLogDB(
+        dirname=os.path.join(dirname, "logdb-sqlite"),
+        kv_factory=lambda d: SqliteKV(d),
+        **kw,
+    )
+
+
+__all__ = ["SqliteKV", "sqlite_logdb_factory"]

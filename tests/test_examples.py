@@ -1,0 +1,22 @@
+"""Keep examples/ honest: helloworld must run end to end (real TCP,
+election, proposals, follower read, transfer, outage, restart)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_helloworld_example(tmp_path):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "helloworld.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(tmp_path),  # its data dir lands here, not in the repo
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "HELLOWORLD PASS" in proc.stdout
