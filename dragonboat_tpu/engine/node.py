@@ -18,6 +18,7 @@ from ..core.peer import Peer, PeerAddress, encode_config_change
 from ..core.logentry import ErrCompacted
 from ..requests import (
     ErrClusterClosed,
+    ErrInvalidSession,
     ErrPayloadTooBig,
     ErrSystemBusy,
     LogicalClock,
@@ -201,6 +202,37 @@ class Node:
             raise ErrSystemBusy()
         self.engine.set_node_ready(self.cluster_id)
         return rs
+
+    def propose_batch(
+        self, session: Session, cmds, timeout_ticks: int
+    ) -> List[RequestState]:
+        """Submit many proposals with one registry lock, one queue lock
+        and one engine wake-up. The per-proposal Python round-trip is the
+        submission ceiling on a pipelined client; batching amortizes it
+        (the engines already ingest and persist in batches). Only no-op
+        sessions may batch: a registered session's at-most-once bookkeeping
+        is strictly sequential (cf. client session semantics,
+        requests.go:141-166). Overflow past the queue capacity completes
+        those requests as DROPPED rather than failing the whole batch."""
+        cmds = list(cmds)  # one-shot iterables must survive the pre-checks
+        if not session.is_noop_session() and len(cmds) > 1:
+            raise ErrInvalidSession()
+        for cmd in cmds:
+            if len(cmd) > soft.max_proposal_payload_size:
+                raise ErrPayloadTooBig()
+        if self._rate_limited:
+            raise ErrSystemBusy()
+        rss, entries = self.pending_proposals.propose_batch(
+            session, cmds, timeout_ticks
+        )
+        for entry in entries:
+            maybe_encode_entry(self.config.entry_compression_type, entry)
+        accepted = self.incoming_proposals.add_many(entries)
+        for entry in entries[accepted:]:
+            self.pending_proposals.dropped(entry.key)
+        if accepted:
+            self.engine.set_node_ready(self.cluster_id)
+        return rss
 
     def read(self, timeout_ticks: int) -> RequestState:
         rs = self.pending_read_indexes.read(timeout_ticks)

@@ -37,6 +37,24 @@ class EntryQueue:
             buf.append(e)
             return True
 
+    def add_many(self, entries: List[Entry]) -> int:
+        """Enqueue a batch under ONE lock acquisition; returns how many
+        were accepted (the tail past capacity is refused and the queue
+        pauses, exactly like a failed add)."""
+        with self._mu:
+            if self.stopped or self._paused:
+                return 0
+            buf = self._left if self._use_left else self._right
+            room = self._size - len(buf)
+            if room <= 0:
+                self._paused = True
+                return 0
+            take = entries[:room]
+            buf.extend(take)
+            if len(take) < len(entries):
+                self._paused = True
+            return len(take)
+
     def get(self, paused: bool = False) -> List[Entry]:
         with self._mu:
             self._paused = paused

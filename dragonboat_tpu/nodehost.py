@@ -333,6 +333,17 @@ class NodeHost(IMessageHandler):
         node = self._get_node(session.cluster_id)
         return node.propose(session, cmd, self._to_ticks(timeout_s))
 
+    def propose_batch(
+        self, session: Session, cmds, timeout_s: float
+    ) -> List[RequestState]:
+        """Pipelined submission: many proposals, one registry/queue lock
+        round-trip and one engine wake-up (no-op sessions only — see
+        Node.propose_batch). The engines ingest, replicate, persist and
+        apply in batches already; this extends the batching to the
+        client boundary."""
+        node = self._get_node(session.cluster_id)
+        return node.propose_batch(session, cmds, self._to_ticks(timeout_s))
+
     def sync_propose(
         self, session: Session, cmd: bytes, timeout_s: float = 4.0
     ) -> Result:

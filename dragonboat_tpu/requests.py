@@ -234,6 +234,37 @@ class _ProposalShard:
             self._pending[rs.key] = rs
         return rs, entry
 
+    def propose_batch(
+        self, session: Session, cmds, timeout_ticks: int
+    ) -> Tuple[List[RequestState], List[Entry]]:
+        """Register a whole batch under ONE lock acquisition — the
+        per-proposal lock round-trip is the submission-path hot spot."""
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        deadline = self._clock.tick + timeout_ticks
+        rss: List[RequestState] = []
+        entries: List[Entry] = []
+        for cmd in cmds:
+            rs = RequestState()
+            rs.key = next(self._key_seq)
+            rs.client_id = session.client_id
+            rs.series_id = session.series_id
+            rs.deadline = deadline
+            rss.append(rs)
+            entries.append(Entry(
+                key=rs.key,
+                client_id=session.client_id,
+                series_id=session.series_id,
+                responded_to=session.responded_to,
+                cmd=cmd,
+            ))
+        with self._mu:
+            if self.stopped:
+                raise ErrClusterClosed()
+            for rs in rss:
+                self._pending[rs.key] = rs
+        return rss, entries
+
     def applied(
         self, key: int, client_id: int, series_id: int, result: Result,
         rejected: bool,
@@ -295,9 +326,7 @@ class PendingProposal:
             for i in range(self.SHARDS)
         ]
 
-    def propose(
-        self, session: Session, cmd: bytes, timeout_ticks: int
-    ) -> Tuple[RequestState, Entry]:
+    def _thread_shard(self) -> "_ProposalShard":
         # thread affinity: each client thread gets a sticky shard index
         # (round-robin at first use — thread idents are pointer-aligned,
         # so ident % SHARDS would collide), keeping concurrent submitters
@@ -305,8 +334,18 @@ class PendingProposal:
         idx = getattr(_shard_tls, "idx", None)
         if idx is None:
             idx = _shard_tls.idx = next(_shard_rr)
-        return self._shards[idx % self.SHARDS].propose(
-            session, cmd, timeout_ticks
+        return self._shards[idx % self.SHARDS]
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> Tuple[RequestState, Entry]:
+        return self._thread_shard().propose(session, cmd, timeout_ticks)
+
+    def propose_batch(
+        self, session: Session, cmds, timeout_ticks: int
+    ) -> Tuple[List[RequestState], List[Entry]]:
+        return self._thread_shard().propose_batch(
+            session, cmds, timeout_ticks
         )
 
     def applied(

@@ -87,11 +87,22 @@ def test_sharded_engine_three_replicas_commit():
                 time.sleep(0.1)
         assert not pending, f"{len(pending)} groups leaderless"
         # one write per group through its leader, quorum-committed across
-        # lanes living on different devices
+        # lanes living on different devices; leadership can churn under
+        # full-suite CPU load between the probe and the propose — retry
+        # against the refreshed leader like a real client
+        from dragonboat_tpu.requests import RequestError
+
         for c in range(1, groups + 1):
-            lid = hosts[1].get_leader_id(c)[0]
-            s = hosts[lid].get_noop_session(c)
-            hosts[lid].sync_propose(s, f"g{c}=v{c}".encode(), 30.0)
+            for attempt in range(4):
+                lid = hosts[1].get_leader_id(c)[0]
+                try:
+                    s = hosts[lid].get_noop_session(c)
+                    hosts[lid].sync_propose(s, f"g{c}=v{c}".encode(), 30.0)
+                    break
+                except RequestError:
+                    if attempt == 3:
+                        raise
+                    time.sleep(0.5)
         # linearizable read-back on a follower host for a few groups
         for c in (1, groups // 2, groups):
             lid = hosts[1].get_leader_id(c)[0]
