@@ -211,22 +211,32 @@ class _ProposalShard:
         )
         self.stopped = False
 
-    def propose(
-        self, session: Session, cmd: bytes, timeout_ticks: int
+    def _make_request(
+        self, session: Session, cmd: bytes, deadline: int
     ) -> Tuple[RequestState, Entry]:
-        if timeout_ticks < 1:
-            raise ErrTimeoutTooSmall()
+        """One registration record; single and batch submission MUST build
+        identical requests (shared so they cannot drift)."""
         rs = RequestState()
         rs.key = next(self._key_seq)
         rs.client_id = session.client_id
         rs.series_id = session.series_id
-        rs.deadline = self._clock.tick + timeout_ticks
+        rs.deadline = deadline
         entry = Entry(
             key=rs.key,
             client_id=session.client_id,
             series_id=session.series_id,
             responded_to=session.responded_to,
             cmd=cmd,
+        )
+        return rs, entry
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_ticks: int
+    ) -> Tuple[RequestState, Entry]:
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        rs, entry = self._make_request(
+            session, cmd, self._clock.tick + timeout_ticks
         )
         with self._mu:
             if self.stopped:
@@ -242,28 +252,13 @@ class _ProposalShard:
         if timeout_ticks < 1:
             raise ErrTimeoutTooSmall()
         deadline = self._clock.tick + timeout_ticks
-        rss: List[RequestState] = []
-        entries: List[Entry] = []
-        for cmd in cmds:
-            rs = RequestState()
-            rs.key = next(self._key_seq)
-            rs.client_id = session.client_id
-            rs.series_id = session.series_id
-            rs.deadline = deadline
-            rss.append(rs)
-            entries.append(Entry(
-                key=rs.key,
-                client_id=session.client_id,
-                series_id=session.series_id,
-                responded_to=session.responded_to,
-                cmd=cmd,
-            ))
+        pairs = [self._make_request(session, cmd, deadline) for cmd in cmds]
         with self._mu:
             if self.stopped:
                 raise ErrClusterClosed()
-            for rs in rss:
+            for rs, _ in pairs:
                 self._pending[rs.key] = rs
-        return rss, entries
+        return [rs for rs, _ in pairs], [e for _, e in pairs]
 
     def applied(
         self, key: int, client_id: int, series_id: int, result: Result,

@@ -96,6 +96,7 @@ def test_propose_batch_overflow_drops_tail(tmp_path):
             if ok:
                 break
             time.sleep(0.02)
+        assert ok, "no leader elected"
         s = nh.get_noop_session(1)
         n = soft.incoming_proposal_queue_length + 64
         rss = nh.propose_batch(s, [b"y"] * n, 30.0)

@@ -1,0 +1,95 @@
+"""ReadIndex tracker conformance matrix (cf. the reference's readIndex
+struct internal/raft/readindex.go and its matrix readindex_test.go:30-200):
+dedup of duplicate contexts, monotone index discipline, quorum
+confirmation releasing every request queued at or before the confirmed
+context, and reset on raft state change."""
+import pytest
+
+from dragonboat_tpu.core.readindex import ReadIndexTracker
+from dragonboat_tpu.types import SystemCtx
+
+
+def ctx(n: int) -> SystemCtx:
+    return SystemCtx(low=n, high=n + 1)
+
+
+def test_same_ctx_cannot_be_added_twice():
+    t = ReadIndexTracker()
+    t.add_request(10, ctx(1), 2)
+    t.add_request(99, ctx(1), 3)  # duplicate: ignored, index unchanged
+    assert len(t.queue) == 1
+    assert t.pending[(1, 2)].index == 10
+
+
+def test_index_must_be_monotone_along_queue():
+    t = ReadIndexTracker()
+    t.add_request(10, ctx(1), 2)
+    with pytest.raises(RuntimeError):
+        t.add_request(9, ctx(2), 2)
+
+
+def test_requests_queue_in_order():
+    t = ReadIndexTracker()
+    for i in range(5):
+        t.add_request(10 + i, ctx(i), 2)
+    assert t.has_pending_request()
+    assert t.peep_ctx() == ctx(4)  # newest context is what heartbeats carry
+
+
+def test_confirmation_requires_quorum():
+    t = ReadIndexTracker()
+    t.add_request(10, ctx(1), 2)
+    # quorum=3: leader counts as one, so TWO distinct acks are needed
+    assert t.confirm(ctx(1), 2, 3) is None
+    assert t.confirm(ctx(1), 2, 3) is None  # same voter again: still 1
+    ready = t.confirm(ctx(1), 3, 3)
+    assert ready is not None and len(ready) == 1
+    assert ready[0].index == 10
+    assert not t.has_pending_request()
+
+
+def test_confirming_later_ctx_releases_earlier_requests_at_its_index():
+    """Everything queued at or before the confirmed context reads at the
+    CONFIRMED index (indexes are monotone along the queue), exactly the
+    batch-release the reference performs (readindex_test.go:125-162)."""
+    t = ReadIndexTracker()
+    t.add_request(10, ctx(1), 2)
+    t.add_request(12, ctx(2), 2)
+    t.add_request(15, ctx(3), 2)
+    ready = t.confirm(ctx(2), 2, 2)
+    assert [r.ctx for r in ready] == [ctx(1), ctx(2)]
+    assert [r.index for r in ready] == [12, 12]
+    # ctx(3) is still outstanding
+    assert t.has_pending_request()
+    assert t.peep_ctx() == ctx(3)
+    ready = t.confirm(ctx(3), 2, 2)
+    assert [r.index for r in ready] == [15]
+
+
+def test_confirm_unknown_ctx_is_stale():
+    t = ReadIndexTracker()
+    t.add_request(10, ctx(1), 2)
+    assert t.confirm(ctx(9), 2, 2) is None
+    assert t.has_pending_request()
+
+
+def test_tracker_resets_on_state_change():
+    """The raft core drops pending reads when leadership/term changes — a
+    fresh tracker replaces the old one (readindex_test.go:164-200). Verify
+    via the core: a follower stepping to candidate clears ready_to_read
+    and pending read contexts."""
+    from tests.raft_harness import Network, new_test_raft
+
+    rafts = {i: new_test_raft(i, [1, 2, 3]) for i in (1, 2, 3)}
+    net = Network(rafts)
+    net.elect(1)
+    leader = rafts[1]
+    from dragonboat_tpu.types import Message, MessageType as MT
+
+    leader.handle(Message(type=MT.READ_INDEX, from_=1, to=1,
+                          hint=7, hint_high=8))
+    assert leader.read_index.has_pending_request()
+    # a higher-term vote request dethrones the leader mid-read
+    net.elect(2)
+    assert not rafts[1].is_leader()
+    assert not rafts[1].read_index.has_pending_request()

@@ -1,0 +1,182 @@
+"""Remote flow-control FSM conformance matrix.
+
+Behavioral parity with the reference's remote states and transitions
+(internal/raft/remote.go:44-198, matrix shapes from remote_test.go:22-360):
+Retry/Wait/Replicate/Snapshot transitions, optimistic pipelining,
+rejection backtracking, snapshot completion gating, pause semantics. The
+same FSM runs as an int8 tensor lane per (group, peer) in the device
+kernel (ops/state.py RSTATE), so this scalar matrix is also the oracle
+for the differential suite.
+"""
+import pytest
+
+from dragonboat_tpu.core.remote import Remote, RemoteState
+
+
+def mk(match=0, next=1, state=RemoteState.RETRY, snapshot_index=0):
+    r = Remote(match=match, next=next, snapshot_index=snapshot_index)
+    r.state = state
+    return r
+
+
+class TestTransitions:
+    def test_become_retry_from_replicate_resets_next_to_match(self):
+        r = mk(match=10, next=25, state=RemoteState.REPLICATE)
+        r.become_retry()
+        assert r.state == RemoteState.RETRY
+        assert r.next == 11
+        assert r.snapshot_index == 0
+
+    def test_become_retry_from_snapshot_keeps_snapshot_floor(self):
+        """After an aborted/complete snapshot the probe restarts above the
+        snapshot index, not at the stale match (remote_test.go:76-110)."""
+        r = mk(match=3, state=RemoteState.SNAPSHOT, snapshot_index=40)
+        r.become_retry()
+        assert r.next == 41
+        assert r.snapshot_index == 0
+        r2 = mk(match=50, state=RemoteState.SNAPSHOT, snapshot_index=40)
+        r2.become_retry()
+        assert r2.next == 51  # match overtook the snapshot
+
+    def test_become_replicate_starts_after_match(self):
+        r = mk(match=7, next=3, state=RemoteState.RETRY)
+        r.become_replicate()
+        assert r.state == RemoteState.REPLICATE
+        assert r.next == 8
+
+    def test_become_snapshot_records_index(self):
+        r = mk(match=7, state=RemoteState.REPLICATE)
+        r.become_snapshot(99)
+        assert r.state == RemoteState.SNAPSHOT
+        assert r.snapshot_index == 99
+
+    def test_become_wait_is_retry_then_pause(self):
+        r = mk(match=5, next=9, state=RemoteState.REPLICATE)
+        r.become_wait()
+        assert r.state == RemoteState.WAIT
+        assert r.next == 6
+
+    def test_wait_retry_round_trip_only_from_matching_state(self):
+        r = mk(state=RemoteState.REPLICATE)
+        r.retry_to_wait()  # no-op outside RETRY
+        assert r.state == RemoteState.REPLICATE
+        r.wait_to_retry()  # no-op outside WAIT
+        assert r.state == RemoteState.REPLICATE
+
+
+class TestProgress:
+    def test_replicate_progress_is_optimistic(self):
+        """Pipelining: next jumps past the just-sent batch without waiting
+        for the ack (remote_test.go:129-149)."""
+        r = mk(match=10, next=11, state=RemoteState.REPLICATE)
+        r.progress(last_index=18)
+        assert r.next == 19
+
+    def test_retry_progress_pauses_probe(self):
+        """One probe message in flight at a time: sending from RETRY moves
+        the remote to WAIT until a response arrives."""
+        r = mk(state=RemoteState.RETRY)
+        r.progress(last_index=5)
+        assert r.state == RemoteState.WAIT
+        assert r.is_paused()
+
+    def test_snapshot_progress_is_invalid(self):
+        r = mk(state=RemoteState.SNAPSHOT, snapshot_index=5)
+        with pytest.raises(RuntimeError):
+            r.progress(3)
+
+
+class TestTryUpdate:
+    def test_advances_match_and_next(self):
+        r = mk(match=3, next=4, state=RemoteState.RETRY)
+        assert r.try_update(9)
+        assert r.match == 9 and r.next == 10
+
+    def test_stale_ack_returns_false_but_keeps_next(self):
+        r = mk(match=9, next=15)
+        assert not r.try_update(7)
+        assert r.match == 9
+        assert r.next == 15  # never decreased by an old ack
+
+    def test_ack_unpauses_wait(self):
+        """A successful ack resumes a paused probe
+        (remote_test.go:323-360 TryUpdateCauseResume)."""
+        r = mk(match=3, next=4, state=RemoteState.WAIT)
+        assert r.try_update(8)
+        assert r.state == RemoteState.RETRY
+        assert not r.is_paused()
+
+
+class TestDecreaseTo:
+    def test_replicate_rejection_backtracks_to_match(self):
+        """In REPLICATE, a rejection above match resets next to match+1 —
+        the optimistic window collapses (remote_test.go:266-288)."""
+        r = mk(match=10, next=30, state=RemoteState.REPLICATE)
+        assert r.decrease_to(rejected=20, last=25)
+        assert r.next == 11
+
+    def test_replicate_rejection_at_or_below_match_is_stale(self):
+        r = mk(match=10, next=30, state=RemoteState.REPLICATE)
+        assert not r.decrease_to(rejected=10, last=25)
+        assert r.next == 30
+
+    def test_probe_rejection_must_match_outstanding_probe(self):
+        """Outside REPLICATE only the response to the CURRENT probe
+        (rejected == next-1) backtracks (remote_test.go:290-321)."""
+        r = mk(match=0, next=10, state=RemoteState.RETRY)
+        assert not r.decrease_to(rejected=4, last=25)
+        assert r.next == 10
+        assert r.decrease_to(rejected=9, last=25)
+        assert r.next == 9  # min(rejected, last+1): back one step
+        r2 = mk(match=0, next=10, state=RemoteState.RETRY)
+        assert r2.decrease_to(rejected=9, last=2)
+        assert r2.next == 3  # follower's log is short: probe its tail
+
+    def test_probe_rejection_unpauses_wait(self):
+        r = mk(match=0, next=10, state=RemoteState.WAIT)
+        assert r.decrease_to(rejected=9, last=20)
+        assert r.state == RemoteState.RETRY
+
+    def test_next_never_below_one(self):
+        r = mk(match=0, next=1, state=RemoteState.RETRY)
+        assert r.decrease_to(rejected=0, last=0)
+        assert r.next == 1
+
+
+class TestSnapshotCompletion:
+    def test_responded_to_leaves_snapshot_only_after_catchup(self):
+        """The remote stays in SNAPSHOT until its match reaches the
+        snapshot index (the install is still in flight before that)."""
+        r = mk(match=3, state=RemoteState.SNAPSHOT, snapshot_index=40)
+        r.responded_to()
+        assert r.state == RemoteState.SNAPSHOT
+        r.try_update(40)
+        r.responded_to()
+        assert r.state == RemoteState.RETRY
+        assert r.next == 41
+
+    def test_responded_to_promotes_retry_to_replicate(self):
+        r = mk(match=5, next=6, state=RemoteState.RETRY)
+        r.responded_to()
+        assert r.state == RemoteState.REPLICATE
+
+    def test_clear_pending_snapshot(self):
+        r = mk(state=RemoteState.SNAPSHOT, snapshot_index=40)
+        r.clear_pending_snapshot()
+        assert r.snapshot_index == 0
+
+
+class TestPauseAndActivity:
+    def test_paused_states(self):
+        assert not mk(state=RemoteState.RETRY).is_paused()
+        assert not mk(state=RemoteState.REPLICATE).is_paused()
+        assert mk(state=RemoteState.WAIT).is_paused()
+        assert mk(state=RemoteState.SNAPSHOT).is_paused()
+
+    def test_activity_flag(self):
+        r = mk()
+        assert not r.is_active()
+        r.set_active()
+        assert r.is_active()
+        r.set_not_active()
+        assert not r.is_active()

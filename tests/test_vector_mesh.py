@@ -94,8 +94,10 @@ def test_sharded_engine_three_replicas_commit():
 
         for c in range(1, groups + 1):
             for attempt in range(4):
-                lid = hosts[1].get_leader_id(c)[0]
+                lid, ok = hosts[1].get_leader_id(c)
                 try:
+                    if not ok or lid not in hosts:
+                        raise RequestError("leaderless between waves")
                     s = hosts[lid].get_noop_session(c)
                     hosts[lid].sync_propose(s, f"g{c}=v{c}".encode(), 30.0)
                     break
