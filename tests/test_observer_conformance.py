@@ -1,0 +1,145 @@
+"""Observer conformance matrix (cf. internal/raft/raft_test.go:318-723,
+raft thesis 4.2.1): a non-voting member replicates and can forward
+proposals/reads but never votes or campaigns; it can be promoted to a
+voting member (including via a snapshot whose membership lists it as
+full), and a full member can never be demoted back by a stale snapshot."""
+import pytest
+
+from dragonboat_tpu.core.raft import RaftNodeState
+from dragonboat_tpu.core.remote import Remote
+from dragonboat_tpu.types import (
+    Membership,
+    Message,
+    MessageType as MT,
+    Snapshot,
+)
+from tests.raft_harness import Network, new_test_raft
+
+
+def cluster_with_observer():
+    """2 voting members + node 3 as observer, leader elected."""
+    r1 = new_test_raft(1, [1, 2])
+    r2 = new_test_raft(2, [1, 2])
+    for r in (r1, r2):
+        r.observers[3] = Remote(next=1)
+    obs = new_test_raft(3, [], is_observer=True)
+    obs.remotes[1] = Remote(next=1)
+    obs.remotes[2] = Remote(next=1)
+    obs.observers[3] = Remote(next=1)
+    net = Network({1: r1, 2: r2, 3: obs})
+    net.elect(1)
+    assert r1.is_leader()
+    return net, r1, obs
+
+
+def test_observer_will_not_start_election():
+    _, _, obs = cluster_with_observer()
+    obs.msgs.clear()
+    for _ in range(20 * obs.election_timeout):
+        obs.tick()
+    assert [m for m in obs.msgs if m.type == MT.REQUEST_VOTE] == []
+
+
+def test_observer_vote_not_counted():
+    """An observer may answer a vote request, but a candidate cannot win
+    with observer support alone: quorum counts voting members only."""
+    r1 = new_test_raft(1, [1, 2, 4])  # 2 and 4 never respond
+    r1.observers[3] = Remote(next=1)
+    net = Network({1: r1})
+    net.elect(1)  # self-vote only: 1 of 3 voting members
+    assert not r1.is_leader()
+    # an (erroneous or stale) grant FROM THE OBSERVER must not tip the
+    # count: quorum is over voting members (raft.go vote-resp handler)
+    r1.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=3, to=1,
+                      term=r1.term))
+    assert not r1.is_leader()
+    # the same grant from a real voting member completes the quorum
+    r1.handle(Message(type=MT.REQUEST_VOTE_RESP, from_=2, to=1,
+                      term=r1.term))
+    assert r1.is_leader()
+
+
+def test_observer_replicates_payloads():
+    net, leader, obs = cluster_with_observer()
+    net.propose(1, b"observer-sees-this")
+    ents = obs.log.get_entries(1, obs.log.last_index() + 1, 1 << 30)
+    assert any(e.cmd == b"observer-sees-this" for e in ents)
+    assert obs.log.committed == leader.log.committed
+
+
+def test_observer_forwards_proposal_to_leader():
+    from dragonboat_tpu.types import Entry
+
+    net, leader, obs = cluster_with_observer()
+    before = leader.log.last_index()
+    obs.handle(Message(type=MT.PROPOSE, from_=3, to=3,
+                       entries=[Entry(cmd=b"via-observer")]))
+    net.deliver_all()
+    assert leader.log.last_index() > before
+    ents = leader.log.get_entries(1, leader.log.last_index() + 1, 1 << 30)
+    assert any(e.cmd == b"via-observer" for e in ents)
+
+
+def test_observer_promotion_to_voting_member():
+    """ADD_NODE on an observer id promotes it; afterwards it votes and can
+    win elections (raft_test.go:346-414)."""
+    net, leader, obs = cluster_with_observer()
+    for r in net.rafts.values():
+        r.add_node(3)
+    assert 3 in leader.remotes and 3 not in leader.observers
+    assert obs.state != RaftNodeState.OBSERVER
+    # the promoted node can now be elected
+    net.elect(3)
+    assert net.rafts[3].is_leader()
+
+
+def test_observer_can_receive_snapshot():
+    _, _, obs = cluster_with_observer()
+    mem = Membership(addresses={1: "a1", 2: "a2"}, observers={3: "o3"})
+    obs.handle(Message(type=MT.INSTALL_SNAPSHOT, from_=1, to=3, term=20,
+                       snapshot=Snapshot(index=20, term=20, membership=mem)))
+    assert obs.log.committed == 20
+
+
+def test_observer_promoted_by_snapshot_membership():
+    """A snapshot whose membership lists the observer as a full member
+    promotes it during restore (raft_test.go:612-668)."""
+    _, _, obs = cluster_with_observer()
+    mem = Membership(addresses={1: "a1", 2: "a2", 3: "a3"})
+    ss = Snapshot(index=20, term=20, membership=mem)
+    obs.handle(Message(type=MT.INSTALL_SNAPSHOT, from_=1, to=3, term=20,
+                       snapshot=ss))
+    assert obs.log.committed == 20
+    # the engine applies the snapshot's membership after SM recovery
+    # (node._do_recover_snapshot -> peer.restore_remotes)
+    obs.restore_remotes(ss)
+    assert 3 in obs.remotes
+    assert obs.state != RaftNodeState.OBSERVER
+
+
+def test_full_member_cannot_be_demoted_by_snapshot():
+    """restore() refuses a snapshot that would move a voting member back
+    to observer (raft_test.go:670-693)."""
+    r1 = new_test_raft(1, [1, 2])
+    net = Network({1: r1, 2: new_test_raft(2, [1, 2])})
+    net.elect(1)
+    follower = net.rafts[2]
+    mem = Membership(addresses={1: "a1"}, observers={2: "o2"})
+    with pytest.raises(RuntimeError,
+                       match="converting non-observer to observer"):
+        follower.handle(Message(
+            type=MT.INSTALL_SNAPSHOT, from_=1, to=2, term=20,
+            snapshot=Snapshot(index=20, term=20, membership=mem),
+        ))
+
+
+def test_observer_add_and_remove():
+    net, leader, obs = cluster_with_observer()
+    # add another observer
+    for r in net.rafts.values():
+        r.add_observer(4)
+    assert 4 in leader.observers
+    # remove the first one
+    for r in net.rafts.values():
+        r.remove_node(3)
+    assert 3 not in leader.observers

@@ -1,0 +1,154 @@
+"""Witness conformance matrix (cf. the reference's witness suite,
+internal/raft/raft_test.go:724-1010, raft thesis 11.7.2): a witness votes
+and counts toward quorum but never campaigns, never holds payloads, can
+never leave witness-hood, serves no reads, and receives witness-shaped
+(metadata/dummy) replication and snapshots."""
+import pytest
+
+from dragonboat_tpu.core.remote import Remote
+from dragonboat_tpu.types import (
+    EntryType,
+    Membership,
+    Message,
+    MessageType as MT,
+    Snapshot,
+)
+from tests.raft_harness import Network, new_test_raft
+
+
+def new_witness(node_id=3, full=(1, 2)):
+    """A witness raft instance: voting members `full`, self as witness."""
+    w = new_test_raft(node_id, [], is_witness=True)
+    for p in full:
+        w.remotes[p] = Remote(next=1)
+    w.witnesses[node_id] = Remote(next=1)
+    return w
+
+
+class TestStateTransitions:
+    def test_witness_cannot_become_observer(self):
+        w = new_witness()
+        with pytest.raises(RuntimeError):
+            w.become_observer(1, 1)
+
+    def test_witness_cannot_become_follower(self):
+        w = new_witness()
+        with pytest.raises(RuntimeError):
+            w.become_follower(1, 1)
+
+    def test_witness_cannot_become_candidate(self):
+        w = new_witness()
+        with pytest.raises(RuntimeError):
+            w.become_candidate()
+
+    def test_witness_cannot_be_promoted_to_full_member(self):
+        w = new_witness()
+        with pytest.raises(RuntimeError):
+            w.add_node(w.node_id)
+
+    def test_non_witness_cannot_add_self_as_witness(self):
+        r = new_test_raft(1, [1, 2])
+        with pytest.raises(RuntimeError):
+            r.add_witness(1)
+
+
+class TestElections:
+    def test_witness_never_starts_election(self):
+        w = new_witness()
+        for _ in range(20 * w.election_timeout):
+            w.tick()
+        assert w.msgs == []
+        assert w.is_witness()
+
+    def test_witness_votes_in_election(self):
+        w = new_witness()
+        w.handle(Message(type=MT.REQUEST_VOTE, from_=2, to=3, term=100,
+                         log_term=100, log_index=100))
+        votes = [m for m in w.msgs if m.type == MT.REQUEST_VOTE_RESP]
+        assert len(votes) == 1
+        assert not votes[0].reject
+
+    def test_witness_counts_toward_commit_quorum(self):
+        """1 full member + 1 witness: the witness's ack is required and
+        sufficient for commit (quorum of 2)."""
+        leader = new_test_raft(1, [1])
+        leader.witnesses[3] = Remote(next=1)
+        w = new_witness(3, full=(1,))
+        net = Network({1: leader, 3: w})
+        net.elect(1)
+        assert leader.is_leader()
+        net.propose(1, b"x")
+        assert leader.log.committed == w.log.committed
+        assert leader.log.committed >= 2  # noop + proposal
+
+
+class TestReplication:
+    def test_witness_receives_metadata_entries_only(self):
+        """Replication toward a witness strips payloads to METADATA
+        entries (raft_test.go:833-889 / :991-1010)."""
+        leader = new_test_raft(1, [1, 2])
+        leader.witnesses[3] = Remote(next=1)
+        peer2 = new_test_raft(2, [1, 2])
+        peer2.witnesses[3] = Remote(next=1)
+        w = new_witness(3)
+        net = Network({1: leader, 2: peer2, 3: w})
+        net.elect(1)
+        net.propose(1, b"payload-bytes")
+        ents = w.log.get_entries(1, w.log.last_index() + 1, 1 << 30)
+        assert ents, "witness received nothing"
+        assert all(e.type == EntryType.METADATA for e in ents)
+        assert all(e.cmd == b"" for e in ents)
+        # the real members hold the payload
+        real = peer2.log.get_entries(1, peer2.log.last_index() + 1, 1 << 30)
+        assert any(e.cmd == b"payload-bytes" for e in real)
+
+    def test_witness_accepts_metadata_replicate_directly(self):
+        from dragonboat_tpu.types import Entry
+
+        w = new_witness(2, full=(1,))
+        m = Message(type=MT.REPLICATE, from_=1, to=2, term=1,
+                    log_index=0, log_term=0, commit=0,
+                    entries=[Entry(index=i, term=1, type=EntryType.METADATA)
+                             for i in (1, 2, 3)])
+        w.handle(m)
+        assert w.log.last_index() == 3
+        assert w.log.committed == 0  # commit follows the leader's commit
+
+
+class TestSnapshotsAndReads:
+    def test_witness_receives_witness_snapshot(self):
+        """InstallSnapshot toward a witness applies and acks at the
+        snapshot index (raft_test.go:962-989); the leader sends a
+        witness-shaped (dummy) image."""
+        w = new_witness(3)
+        mem = Membership(addresses={1: "a1", 2: "a2"}, witnesses={3: "w3"})
+        ss = Snapshot(index=20, term=20, membership=mem, witness=True)
+        w.handle(Message(type=MT.INSTALL_SNAPSHOT, from_=1, to=3, term=20,
+                         snapshot=ss))
+        assert w.log.committed == 20
+        resps = [m for m in w.msgs if m.log_index == 20]
+        assert resps, f"no snapshot ack at 20 in {w.msgs}"
+
+    def test_leader_sends_witness_shaped_snapshot(self):
+        """The snapshot the leader builds FOR a witness is marked witness
+        (payload-free) (cf. raft.py _make_witness_snapshot)."""
+        leader = new_test_raft(1, [1])
+        leader.witnesses[3] = Remote(next=1)
+        net = Network({1: leader})
+        net.elect(1)
+        leader.log.inmem.restore(Snapshot(index=10, term=leader.term))
+        m, idx = leader.make_install_snapshot_message(3)
+        assert idx == 10
+        assert m.snapshot.witness
+
+    def test_witness_ignores_read_index(self):
+        """A witness neither serves nor forwards reads: the READ_INDEX is
+        dropped outright — no response, no forward to the leader (a
+        follower WOULD forward it)."""
+        w = new_witness()
+        w.set_leader_id(1)
+        w.msgs.clear()
+        w.handle(Message(type=MT.READ_INDEX, from_=3, to=3,
+                         hint=12345, hint_high=1))
+        assert w.ready_to_read == []
+        assert w.msgs == [], f"witness produced {w.msgs}"
