@@ -48,7 +48,7 @@ def _nh_config(nid, tmp, reg):
     )
 
 
-def _wait_leader(hosts, deadline_s=20):
+def _wait_leader(hosts, deadline_s=60):
     deadline = time.time() + deadline_s
     while time.time() < deadline:
         for nid, nh in hosts.items():
@@ -85,7 +85,7 @@ def test_import_snapshot_quorum_repair(tmp_path):
     leader = _wait_leader(hosts)
     s = hosts[leader].get_noop_session(CLUSTER)
     for i in range(10):
-        hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), timeout_s=5.0)
+        hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), timeout_s=15.0)
 
     export_root = str(tmp_path / "export")
     os.makedirs(export_root)

@@ -78,7 +78,7 @@ def test_sharded_engine_three_replicas_commit():
                            heartbeat_rtt=4),
                 )
         pending = set(range(1, groups + 1))
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 150
         while pending and time.monotonic() < deadline:
             pending -= {
                 c for c in pending if hosts[1].get_leader_id(c)[1]

@@ -78,7 +78,7 @@ def test_1024_lanes_elect_and_commit():
     groups = 1024
     nh = _mk_host(groups)
     try:
-        pending = _wait_leaders(nh, groups, 60)
+        pending = _wait_leaders(nh, groups, 150)
         assert not pending, f"{len(pending)} lanes never elected a leader"
         # one committed proposal per lane, pipelined
         outstanding = [
@@ -98,7 +98,7 @@ def test_idle_quiesced_lanes_cost_no_host_work():
     nh = _mk_host(groups, quiesce=True)
     eng = nh.engine
     try:
-        pending = _wait_leaders(nh, groups, 60)
+        pending = _wait_leaders(nh, groups, 150)
         assert not pending
         # commit one proposal per lane so there is real log state
         for c in range(1, groups + 1):
