@@ -594,6 +594,17 @@ class VectorEngine:
             "entry_cc": np.zeros((G, K, E), bool),
         }
         self._ticks = np.zeros((G,), np.int32)
+        # the buffers are mutated in place and never rebound, so the
+        # Inbox view over them — and, when sharded, the matching sharding
+        # pytree for the one-call device_put — are built exactly once
+        self._host_inbox = Inbox(**{
+            f: self._buf[f] for f in Inbox._fields
+        })
+        if self._sharding is not None:
+            self._inbox_shardings = (
+                jax.tree_util.tree_map(self._sharding, self._host_inbox),
+                self._sharding(self._ticks),
+            )
 
     def _alloc_mirrors(self) -> None:
         """Whole-G numpy mirrors of per-lane protocol state, refreshed from
@@ -751,35 +762,16 @@ class VectorEngine:
             self._ticks *= self._m_active
         else:
             self._ticks.fill(0)
-        buf = self._buf
-        host_inbox = Inbox(
-            mtype=buf["mtype"],
-            from_slot=buf["from_slot"],
-            term=buf["term"],
-            log_index=buf["log_index"],
-            log_term=buf["log_term"],
-            commit=buf["commit"],
-            reject=buf["reject"],
-            hint=buf["hint"],
-            n_entries=buf["n_entries"],
-            entry_terms=buf["entry_terms"],
-            entry_cc=buf["entry_cc"],
-        )
         # ONE device_put over the (inbox, ticks) pytree: 12 small host
         # arrays ship in a single batched transfer instead of 12 dispatch
-        # round-trips (per-call overhead dominates at these sizes)
+        # round-trips (per-call overhead dominates at these sizes); the
+        # Inbox view and sharding pytree were built once at allocation
         if self._sharding is not None:
-            if self._inbox_shardings is None:
-                # built once: buffer shapes are fixed at allocation
-                self._inbox_shardings = (
-                    jax.tree_util.tree_map(self._sharding, host_inbox),
-                    self._sharding(self._ticks),
-                )
             inbox, tarr = jax.device_put(
-                (host_inbox, self._ticks), self._inbox_shardings
+                (self._host_inbox, self._ticks), self._inbox_shardings
             )
         else:
-            inbox, tarr = jax.device_put((host_inbox, self._ticks))
+            inbox, tarr = jax.device_put((self._host_inbox, self._ticks))
         self._state, out = self._step_fn(self._state, inbox, tarr)
         self._decode(work, out)
 

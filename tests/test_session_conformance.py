@@ -1,0 +1,122 @@
+"""Client-session conformance matrix (cf. internal/rsm/session.go +
+lrusession.go, matrices from session_test.go:28-200 and
+lrusession_test.go:26-260): at-most-once response caching, cumulative
+clearing, LRU eviction with order preserved across snapshot
+save/restore, and registration lifecycle."""
+from dragonboat_tpu.rsm.session import Session, SessionManager
+from dragonboat_tpu.statemachine import Result
+
+
+class TestResponseCache:
+    def test_response_can_be_added_and_fetched(self):
+        s = Session(client_id=7)
+        s.add_response(1, Result(value=100))
+        r, hit = s.get_response(1)
+        assert hit and r.value == 100
+        _, miss = s.get_response(2)
+        assert not miss
+
+    def test_clear_to_is_cumulative(self):
+        """clear_to(n) drops every cached response at or below n — the
+        client's responded_to watermark frees server memory
+        (session_test.go:59-89)."""
+        s = Session(client_id=7)
+        for i in range(1, 6):
+            s.add_response(i, Result(value=i))
+        s.clear_to(3)
+        for i in (1, 2, 3):
+            assert not s.get_response(i)[1]
+        for i in (4, 5):
+            r, hit = s.get_response(i)
+            assert hit and r.value == i
+
+    def test_has_responded_tracks_watermark(self):
+        """Queries at or below the cleared watermark report 'already
+        responded' even though the payload is gone
+        (session_test.go:91-119)."""
+        s = Session(client_id=7)
+        for i in range(1, 4):
+            s.add_response(i, Result(value=i))
+        s.clear_to(2)
+        assert s.has_responded(1)
+        assert s.has_responded(2)
+        assert not s.has_responded(3) or s.get_response(3)[1]
+
+    def test_session_save_load_roundtrip(self):
+        s = Session(client_id=9)
+        s.add_response(4, Result(value=44, data=b"blob"))
+        s.clear_to(2)
+        blob = s.save()
+        s2, _ = Session.load(blob)
+        assert s2.client_id == 9
+        r, hit = s2.get_response(4)
+        assert hit and r.value == 44 and r.data == b"blob"
+        assert s2.has_responded(2)
+
+
+class TestSessionManagerLRU:
+    def test_eviction_is_lru_ordered(self):
+        """Filling past capacity evicts the LEAST recently used client,
+        and touching a session refreshes it (lrusession_test.go:26-118)."""
+        m = SessionManager(max_sessions=3)
+        for cid in (1, 2, 3):
+            m.register_client_id(cid)
+        # touch 1 so 2 becomes the LRU
+        assert m.get_registered_client(1) is not None
+        m.register_client_id(4)  # evicts 2
+        assert m.get_registered_client(2) is None
+        for cid in (1, 3, 4):
+            assert m.get_registered_client(cid) is not None, cid
+
+    def test_sessions_are_mutable_in_place(self):
+        """Responses added through the manager land on the SAME session
+        object it stores (lrusession_test.go:63-92)."""
+        m = SessionManager(max_sessions=4)
+        m.register_client_id(5)
+        s = m.get_registered_client(5)
+        m.add_response(s, 1, Result(value=77))
+        again = m.get_registered_client(5)
+        assert again.get_response(1)[1]
+        assert again.get_response(1)[0].value == 77
+
+    def test_save_restore_preserves_lru_order(self):
+        """After snapshot save/load the eviction order must be the SAME —
+        replicas diverge otherwise (lrusession_test.go:120-193)."""
+        m = SessionManager(max_sessions=3)
+        for cid in (1, 2, 3):
+            m.register_client_id(cid)
+        m.get_registered_client(1)  # order now: 2 (LRU), 3, 1
+
+        m2 = SessionManager(max_sessions=3)
+        m2.load(m.save())
+        assert len(m2) == 3
+        m2.register_client_id(9)  # must evict 2, as the original would
+        assert m2.get_registered_client(2) is None
+        for cid in (1, 3, 9):
+            assert m2.get_registered_client(cid) is not None, cid
+
+    def test_save_restore_hash_stable(self):
+        """Identical session state must hash identically across replicas
+        (the chaos suite compares session hashes)."""
+        m = SessionManager(max_sessions=4)
+        m.register_client_id(1)
+        s = m.get_registered_client(1)
+        m.add_response(s, 3, Result(value=5))
+        m2 = SessionManager(max_sessions=4)
+        m2.load(m.save())
+        assert m.hash() == m2.hash()
+
+    def test_empty_manager_roundtrip(self):
+        m = SessionManager(max_sessions=2)
+        m2 = SessionManager(max_sessions=2)
+        m2.load(m.save())
+        assert len(m2) == 0
+
+    def test_unregister_removes_session(self):
+        m = SessionManager(max_sessions=4)
+        m.register_client_id(1)
+        m.unregister_client_id(1)
+        assert m.get_registered_client(1) is None
+        # unregistering an unknown client reports rejection, not a crash
+        r = m.unregister_client_id(42)
+        assert r is not None
