@@ -18,6 +18,14 @@ import shutil
 import sys
 import time
 
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # pin the cpu backend BEFORE jax initializes: this environment's axon
+    # TPU-tunnel plugin ignores JAX_PLATFORMS and can hang when the
+    # tunnel is busy (see dragonboat_tpu/_jaxenv.py)
+    from dragonboat_tpu._jaxenv import pin_cpu
+
+    pin_cpu()
+
 from dragonboat_tpu.config import Config, NodeHostConfig
 from dragonboat_tpu.nodehost import NodeHost
 from dragonboat_tpu.statemachine import IStateMachine, Result

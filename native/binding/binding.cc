@@ -29,7 +29,20 @@ namespace {
 
 const char* _GLUE = R"PY(
 import json as _json
+import os as _os
 import threading as _threading
+
+# When the embedder asked for the cpu backend, pin it BEFORE anything can
+# initialize jax: the environment's axon TPU-tunnel plugin monkeypatches
+# backend resolution and ignores JAX_PLATFORMS, and its client creation
+# can hang when the tunnel is busy (see dragonboat_tpu/_jaxenv.py).
+if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    try:
+        from dragonboat_tpu._jaxenv import pin_cpu as _pin_cpu
+
+        _pin_cpu()
+    except Exception:
+        pass
 
 from dragonboat_tpu.config import Config, NodeHostConfig
 from dragonboat_tpu.nodehost import NodeHost

@@ -60,7 +60,7 @@ class CvEvent : public dbtpu::Event {
 };
 
 bool wait_leader(dbtpu::NodeHost& nh, dbtpu::ClusterID c) {
-  for (int i = 0; i < 3000; i++) {
+  for (int i = 0; i < 6000; i++) {
     dbtpu::LeaderID lid;
     if (nh.GetLeaderID(c, &lid).OK() && lid.HasLeaderInfo()) return true;
     std::this_thread::sleep_for(std::chrono::milliseconds(20));
@@ -187,7 +187,7 @@ int main(int argc, char** argv) {
     // --- snapshot on demand
     uint64_t snap_index = 0;
     // generous: snapshot IO competes with the whole suite on 1-cpu CI
-    st = nh.SyncRequestSnapshot(kCluster, "", 60.0, &snap_index);
+    st = nh.SyncRequestSnapshot(kCluster, "", 120.0, &snap_index);
     if (!st.OK() || snap_index == 0) return fail("snapshot", st);
 
     // --- NodeHost info

@@ -67,7 +67,7 @@ def test_oo_demo_runs(tmp_path):
     env["DBTPU_DISKKV_DIR"] = str(tmp_path / "diskkv")
     proc = subprocess.run(
         [_OO_DEMO, str(tmp_path), _ONDISK_PLUGIN],
-        capture_output=True, text=True, timeout=300, env=env,
+        capture_output=True, text=True, timeout=600, env=env,
     )
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "OO DEMO PASS" in proc.stdout

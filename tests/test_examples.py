@@ -15,7 +15,7 @@ def test_helloworld_example(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "examples", "helloworld.py")],
-        capture_output=True, text=True, timeout=600, env=env,
+        capture_output=True, text=True, timeout=900, env=env,
         cwd=str(tmp_path),  # its data dir lands here, not in the repo
     )
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
