@@ -31,7 +31,7 @@ import tempfile
 import time
 
 
-from dragonboat_tpu._jaxenv import pin_cpu
+from dragonboat_tpu._jaxenv import maybe_pin_cpu, pin_cpu
 
 BASELINE_PROPOSALS_PER_SEC = 9_000_000  # reference README.md:46 (3-node peak)
 
@@ -45,7 +45,7 @@ def _ensure_live_backend() -> str:
     a short timeout suffices; retry once), and fall back to a guarded CPU
     backend if the accelerator is unreachable. Returns the platform name."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        pin_cpu()
+        maybe_pin_cpu()
         return "cpu"
     probe = (
         "import jax, sys; d = jax.devices(); "

@@ -49,3 +49,14 @@ def pin_cpu(n_devices: int | None = None) -> None:
         _xb._backend_factories.pop("axon", None)
     except Exception:  # pragma: no cover - internal layout changed
         pass
+
+
+def maybe_pin_cpu() -> None:
+    """pin_cpu() iff the process was asked for the cpu platform via
+    JAX_PLATFORMS=cpu — the one-line guard every cpu-capable entry point
+    (bench, examples, the embedding glue) must run before anything can
+    initialize jax. Raises pin_cpu's RuntimeError if a backend already
+    initialized: silently proceeding would leave the axon tunnel factory
+    registered, which is exactly the hang this guard exists to prevent."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        pin_cpu()

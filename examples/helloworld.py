@@ -18,13 +18,12 @@ import shutil
 import sys
 import time
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # pin the cpu backend BEFORE jax initializes: this environment's axon
-    # TPU-tunnel plugin ignores JAX_PLATFORMS and can hang when the
-    # tunnel is busy (see dragonboat_tpu/_jaxenv.py)
-    from dragonboat_tpu._jaxenv import pin_cpu
+# pin the cpu backend BEFORE jax initializes when JAX_PLATFORMS=cpu was
+# requested (see dragonboat_tpu/_jaxenv.py: the axon TPU-tunnel plugin
+# ignores the env var and can hang)
+from dragonboat_tpu._jaxenv import maybe_pin_cpu
 
-    pin_cpu()
+maybe_pin_cpu()
 
 from dragonboat_tpu.config import Config, NodeHostConfig
 from dragonboat_tpu.nodehost import NodeHost

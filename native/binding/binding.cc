@@ -33,16 +33,15 @@ import os as _os
 import threading as _threading
 
 # When the embedder asked for the cpu backend, pin it BEFORE anything can
-# initialize jax: the environment's axon TPU-tunnel plugin monkeypatches
-# backend resolution and ignores JAX_PLATFORMS, and its client creation
-# can hang when the tunnel is busy (see dragonboat_tpu/_jaxenv.py).
-if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    try:
-        from dragonboat_tpu._jaxenv import pin_cpu as _pin_cpu
-
-        _pin_cpu()
-    except Exception:
-        pass
+# initialize jax (see dragonboat_tpu/_jaxenv.py: the axon TPU-tunnel
+# plugin ignores JAX_PLATFORMS and can hang). A too-late pin raises — a
+# silent fallthrough would re-arm exactly the hang this guard prevents.
+try:
+    from dragonboat_tpu._jaxenv import maybe_pin_cpu as _maybe_pin_cpu
+except ImportError:  # stripped-down install without the guard module
+    pass
+else:
+    _maybe_pin_cpu()
 
 from dragonboat_tpu.config import Config, NodeHostConfig
 from dragonboat_tpu.nodehost import NodeHost
