@@ -96,6 +96,16 @@ class EngineConfig:
     proposal_lanes: int = 1
     # How many protocol micro-steps (inbox drain rounds) per kernel launch.
     micro_steps: int = 1
+    # Max entries carried by one inbox row / Replicate message. The kernel's
+    # ring-slot scatter is O(G*W) regardless of this value, so raising it
+    # widens per-step ingestion at the cost of inbox transfer size only.
+    max_entries_per_msg: int = 8
+    # Co-hosted engine sharing: NodeHosts in one process constructed with
+    # the same non-None scope string share ONE VectorEngine device state, so
+    # all their replicas advance in a single kernel step and messages
+    # between them short-circuit the transport (the TPU-native deployment
+    # shape: one engine per accelerator host, many NodeHost replicas on it).
+    share_scope: "Optional[str]" = None
 
 
 @dataclass

@@ -53,8 +53,11 @@ class WorkReady:
         self._events = [threading.Event() for _ in range(partitions)]
         self._locks = [threading.Lock() for _ in range(partitions)]
 
-    def partition(self, cluster_id: int) -> int:
-        return cluster_id % self._n
+    def partition(self, cluster_id) -> int:
+        # hash() so composite keys work too (the shared VectorEngine keys
+        # work by (host, cluster_id)); hash(int) == int keeps the scalar
+        # engine's partition layout unchanged
+        return hash(cluster_id) % self._n
 
     def notify(self, cluster_id: int) -> None:
         p = self.partition(cluster_id)

@@ -70,6 +70,7 @@ from ..ops.state import (
 )
 from ..requests import LogicalClock
 from ..settings import soft
+from ..trace import Profiler
 from ..types import (
     Entry,
     EntryType,
@@ -378,6 +379,7 @@ class _Lane:
 
     __slots__ = (
         "g",
+        "key",
         "node",
         "cfg",
         "slots",
@@ -398,8 +400,9 @@ class _Lane:
         "mem_sig",
     )
 
-    def __init__(self, g: int, node: VectorNode) -> None:
+    def __init__(self, g: int, node: VectorNode, key=None) -> None:
         self.g = g
+        self.key = key if key is not None else node.cluster_id
         self.node = node
         self.cfg: Config = node.config
         self.slots: Dict[int, int] = {}  # node_id -> slot
@@ -493,9 +496,18 @@ class VectorEngine:
             peers=ecfg.max_peers if ecfg else 8,
             log_window=ecfg.log_window if ecfg else 128,
             inbox_depth=ecfg.inbox_depth if ecfg else 8,
-            max_entries_per_msg=8,
+            max_entries_per_msg=(
+                getattr(ecfg, "max_entries_per_msg", 8) if ecfg else 8
+            ),
             readindex_depth=ecfg.readindex_depth if ecfg else 4,
         )
+        if self.kcfg.max_entries_per_msg > self.kcfg.log_window:
+            # the kernel's ring-slot scatter maps each written index to a
+            # unique slot only while a message's span fits the window
+            raise ValueError(
+                f"max_entries_per_msg ({self.kcfg.max_entries_per_msg}) must "
+                f"not exceed log_window ({self.kcfg.log_window})"
+            )
         # multi-device: shard the group axis over every visible device
         # (SURVEY §2.9.1 — groups are independent Raft instances, so the
         # kernel partitions along G with zero collectives on the hot path)
@@ -523,23 +535,37 @@ class VectorEngine:
 
             self._sharding = _shard_for
         self.clock = _SharedClock()
+        # stage profiler for the hot loop (cf. reference execengine.go
+        # :197-211 + trace.go:98-162); every step is recorded — the cost is
+        # two clock reads per stage, noise next to a kernel launch
+        self.profiler = Profiler(sample_ratio=1)
         self._step_fn = make_step_fn(self.kcfg, donate=True)
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
             self._state = jax.tree.map(
                 lambda x: jax.device_put(x, self._sharding(x)), self._state
             )
-        self._lanes: Dict[int, _Lane] = {}  # cluster_id -> lane
+        # lanes keyed by (host, cluster_id): a SHARED core hosts replicas
+        # from several NodeHosts (hosts = handle ids), so cluster_id alone
+        # does not identify a lane
+        self._lanes: Dict[tuple, _Lane] = {}
+        # (cluster_id, node_id) -> lane, for in-core message short-circuit
+        self._route: Dict[tuple, _Lane] = {}
         self._free = list(range(self.kcfg.groups - 1, -1, -1))
         self._lanes_mu = threading.RLock()
         self._reconq: deque = deque()  # host->device ops, loop-applied
         self._stopped = threading.Event()
         self._ready = threading.Event()
+        # ---- host sharing (handles) --------------------------------------
+        self._hosts_mu = threading.Lock()
+        self._host_refs: Set[int] = set()
+        self._next_host = 0
+        self._blocked_hosts: Set[int] = set()  # partitioned NodeHosts
         # ---- host-event staging (producers: API/transport threads) -------
         self._dirty_mu = threading.Lock()
-        self._dirty: Set[int] = set()  # cluster ids with host events
-        self._gc_set: Set[int] = set()  # cluster ids with pending requests
-        self._pending_ticks = 0  # engine-global coalesced tick counter
+        self._dirty: Set[tuple] = set()  # lane keys with host events
+        self._gc_set: Set[tuple] = set()  # lane keys with pending requests
+        self._pending_ticks: Dict[int, int] = {}  # host -> coalesced ticks
         # ---- loop-thread-only work sets ----------------------------------
         self._carry: Set[_Lane] = set()  # lanes with leftover staged work
         self._catchups: Set[_Lane] = set()  # lanes replaying host log
@@ -579,7 +605,8 @@ class VectorEngine:
 
     def _alloc_buffers(self) -> None:
         # numpy staging buffers for the inbox (reused across steps)
-        G, K, E = self.kcfg.groups, self.kcfg.inbox_depth, 8
+        G, K = self.kcfg.groups, self.kcfg.inbox_depth
+        E = self.kcfg.max_entries_per_msg
         self._buf = {
             "mtype": np.full((G, K), MSG.NONE, np.int32),
             "from_slot": np.zeros((G, K), np.int32),
@@ -624,6 +651,7 @@ class VectorEngine:
         self._m_applied_since = np.zeros(G, np.int64)
         self._m_snap_pending = np.zeros(G, bool)
         self._m_quiesced = np.zeros(G, bool)
+        self._m_host = np.zeros(G, np.int32)  # owning handle id per lane
 
     # ------------------------------------------------------- mirror helpers
     def _committed_real(self, g: int) -> int:
@@ -633,51 +661,98 @@ class VectorEngine:
         return int(self._m_base[g] + self._m_last[g])
 
     # --------------------------------------------------------- registration
-    def add_node(self, node: VectorNode) -> None:
+    def add_node(self, node: VectorNode, host: int = 0) -> None:
+        key = (host, node.cluster_id)
         with self._lanes_mu:
             if not self._free:
                 raise RuntimeError(
                     f"vector engine lane capacity ({self.kcfg.groups}) exhausted"
                 )
             g = self._free.pop()
-            lane = _Lane(g, node)
-            self._lanes[node.cluster_id] = lane
+            lane = _Lane(g, node, key=key)
+            self._lanes[key] = lane
             self._lane_by_g[g] = lane
+            self._route[(node.cluster_id, node.node_id())] = lane
+            self._m_host[g] = host
         node._vec_lane = lane
         self._reconq.append(("activate", lane))
-        self.set_node_ready(node.cluster_id)
+        self.set_node_ready(key)
 
-    def remove_node(self, cluster_id: int) -> None:
+    def remove_node(self, key) -> None:
         with self._lanes_mu:
-            lane = self._lanes.pop(cluster_id, None)
+            lane = self._lanes.pop(key, None)
+            if lane is not None:
+                rk = (lane.node.cluster_id, lane.node.node_id())
+                if self._route.get(rk) is lane:
+                    del self._route[rk]
         if lane is not None:
             self._reconq.append(("deactivate", lane))
             self._ready.set()
 
-    def get_node(self, cluster_id: int):
+    def get_node(self, key):
         with self._lanes_mu:
-            lane = self._lanes.get(cluster_id)
+            lane = self._lanes.get(key)
         return lane.node if lane is not None else None
 
     # -------------------------------------------------------------- wakeups
-    def set_node_ready(self, cluster_id: int) -> None:
+    def set_node_ready(self, key) -> None:
         with self._dirty_mu:
-            self._dirty.add(cluster_id)
-            self._gc_set.add(cluster_id)
+            self._dirty.add(key)
+            self._gc_set.add(key)
         self._ready.set()
 
-    def global_tick(self) -> None:
-        """One logical tick for every lane (replaces per-lane LocalTick
-        messages; the host folds the count into the device tick array)."""
+    def _wake(self, key) -> None:
+        """Like set_node_ready but without arming request GC — the hot path
+        for message delivery (messages alone never need a timeout sweep)."""
         with self._dirty_mu:
-            self._pending_ticks += 1
+            self._dirty.add(key)
         self._ready.set()
 
-    def set_task_ready(self, cluster_id: int) -> None:
-        self.task_ready.notify(cluster_id)
+    def global_tick(self, host: int = 0) -> None:
+        """One logical tick for every lane of `host` (replaces per-lane
+        LocalTick messages; the loop folds counts into the device tick
+        array, per owning host)."""
+        with self._dirty_mu:
+            self._pending_ticks[host] = self._pending_ticks.get(host, 0) + 1
+        self._ready.set()
 
-    def set_snapshot_ready(self, cluster_id: int) -> None:
-        self.snapshot_ready.notify(cluster_id)
+    def set_task_ready(self, key) -> None:
+        self.task_ready.notify(key)
+
+    def set_snapshot_ready(self, key) -> None:
+        self.snapshot_ready.notify(key)
+
+    # ------------------------------------------------------ local delivery
+    def try_local_deliver(self, m: Message) -> bool:
+        """Deliver a wire message directly to a co-hosted lane of this core
+        (same engine => same process), skipping the transport and codec
+        entirely. This is the host half of SURVEY §7's 'co-hosted replica
+        exchange': replicas that advance in one kernel step exchange their
+        protocol traffic through the shared inbox, not the network.
+        InstallSnapshot is excluded — snapshot images move through the
+        streaming path so the receiver owns its on-disk copy."""
+        if m.type == MT.INSTALL_SNAPSHOT:
+            return False
+        lane = self._route.get((m.cluster_id, m.to))
+        if lane is None:
+            return False
+        if lane.key[0] in self._blocked_hosts:
+            # the receiving NodeHost simulates a partition: co-hosted
+            # traffic must drop exactly like the wire path does
+            # (nodehost.handle_message_batch returns early when
+            # partitioned)
+            return True
+        node = lane.node
+        if node.stopped or not node.mq.add(m):
+            return False
+        self._wake(lane.key)
+        return True
+
+    def set_host_partitioned(self, host: int, partitioned: bool) -> None:
+        if partitioned:
+            self._blocked_hosts.add(host)
+        else:
+            self._blocked_hosts.discard(host)
 
     # ------------------------------------------------- host->device bridges
     def membership_changed(self, node: VectorNode) -> None:
@@ -728,8 +803,9 @@ class VectorEngine:
         with self._dirty_mu:
             dirty = self._dirty
             self._dirty = set()
-            ticks = self._pending_ticks
-            self._pending_ticks = 0
+            tick_counts = self._pending_ticks
+            self._pending_ticks = {}
+            ticks = max(tick_counts.values()) if tick_counts else 0
             gc_cids = list(self._gc_set) if ticks else ()
         if ticks:
             for _ in range(ticks):
@@ -744,7 +820,11 @@ class VectorEngine:
                     if lane is not None and lane.active:
                         work.add(lane)
         work |= self._catchups
+        prof = self.profiler
+        prof.new_iteration(len(work))
+        prof.start()
         had = self._pack(work)
+        prof.end("pack")
         if not had:
             if ticks == 0:
                 return
@@ -758,7 +838,17 @@ class VectorEngine:
             if bool(np.all(~act | self._m_quiesced)):
                 return
         if ticks:
-            np.minimum(self._m_tick_cap, ticks, out=self._ticks)
+            # per-lane tick counts come from the OWNING host's counter (a
+            # shared core serves several NodeHosts, each with its own tick
+            # thread); capped per lane at its election RTT
+            if self._next_host <= 1:
+                per_lane = ticks
+            else:
+                hv = np.zeros(self._next_host + 1, np.int32)
+                for h, c in tick_counts.items():
+                    hv[h] = c
+                per_lane = hv[self._m_host]
+            np.minimum(self._m_tick_cap, per_lane, out=self._ticks)
             self._ticks *= self._m_active
         else:
             self._ticks.fill(0)
@@ -766,6 +856,7 @@ class VectorEngine:
         # arrays ship in a single batched transfer instead of 12 dispatch
         # round-trips (per-call overhead dominates at these sizes); the
         # Inbox view and sharding pytree were built once at allocation
+        prof.start()
         if self._sharding is not None:
             inbox, tarr = jax.device_put(
                 (self._host_inbox, self._ticks), self._inbox_shardings
@@ -773,7 +864,10 @@ class VectorEngine:
         else:
             inbox, tarr = jax.device_put((self._host_inbox, self._ticks))
         self._state, out = self._step_fn(self._state, inbox, tarr)
-        self._decode(work, out)
+        # ONE consolidated device->host transfer for the whole StepOutput
+        o = jax.device_get(out)._asdict()
+        prof.end("step")
+        self._decode(work, o)
 
     def _run_gc(self, gc_cids) -> None:
         """Request-timeout pass over lanes with outstanding requests only
@@ -1143,7 +1237,7 @@ class VectorEngine:
         # (cf. raft.go:1415-1449 term preamble)
         lane.adopted_term = max(lane.adopted_term, m.term)
         # persist the snapshot record before recovery (restart safety)
-        self._logdb.save_raft_state(
+        node.logdb.save_raft_state(
             [
                 Update(
                     cluster_id=lane.node.cluster_id,
@@ -1155,9 +1249,9 @@ class VectorEngine:
         lane.node._push_install_snapshot(ss)
 
     # --------------------------------------------------------------- decode
-    def _decode(self, worked: Set[_Lane], out) -> None:
-        # ONE consolidated device->host transfer for the whole StepOutput
-        o = jax.device_get(out)._asdict()
+    def _decode(self, worked: Set[_Lane], o: dict) -> None:
+        prof = self.profiler
+        prof.start()
         lane_by_g = self._lane_by_g
         base = self._m_base
         updates: List[Update] = []
@@ -1241,7 +1335,9 @@ class VectorEngine:
                 continue
             nid = lane.rev.get(int(new_leader[g]) - 1, 0)
             lane.node._leader_event(nid, int(new_term[g]))
+        prof.end("place")
         # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
+        prof.start()
         send_flags = o["send_flags"]
         rep_gs, rep_ps = np.nonzero(send_flags & SEND_REPLICATE)
         for g, p in zip(rep_gs.tolist(), rep_ps.tolist()):
@@ -1275,7 +1371,9 @@ class VectorEngine:
                     entries=ents,
                 )
             )
+        prof.end("send_rep")
         # ---- phase 2: one batched fsynced write for every lane -----------
+        prof.start()
         save_gs = np.nonzero((o["save_from"] > 0) | o["hard_changed"])[0]
         for g in save_gs.tolist():
             lane = lane_by_g[g]
@@ -1311,12 +1409,29 @@ class VectorEngine:
                 )
                 lane_saves.append((lane, ents, state))
         if updates:
-            self._logdb.save_raft_state(updates)
+            # one batched fsynced write per backing logdb — a shared core
+            # hosts lanes from several NodeHosts, each with its own WAL
+            if self._next_host <= 1:
+                self._logdb.save_raft_state(updates)
+            elif len(lane_saves) == 1:
+                lane_saves[0][0].node.logdb.save_raft_state(updates)
+            else:
+                by_db: Dict[int, tuple] = {}
+                for (lane, _e, _s), ud in zip(lane_saves, updates):
+                    db = lane.node.logdb
+                    ent = by_db.get(id(db))
+                    if ent is None:
+                        ent = by_db[id(db)] = (db, [])
+                    ent[1].append(ud)
+                for db, uds in by_db.values():
+                    db.save_raft_state(uds)
         for lane, ents, state in lane_saves:
             if ents:
                 lane.node.log_reader.append(ents)
             lane.node.log_reader.set_state(state)
+        prof.end("save")
         # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
+        prof.start()
         for flag, mk in (
             (SEND_VOTE_REQ, self._mk_vote),
             (SEND_HEARTBEAT, self._mk_heartbeat),
@@ -1343,7 +1458,9 @@ class VectorEngine:
             lane = lane_by_g[g]
             if lane is not None:
                 self._start_catchup(lane, p, o)
+        prof.end("send_resp")
         # ---- phase 4: hand committed entries to the RSM ------------------
+        prof.start()
         from ..rsm import Task
 
         apply_gs = np.nonzero(o["apply_from"])[0]
@@ -1379,7 +1496,7 @@ class VectorEngine:
             lane.arena.mark_applied(b + at)
             if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
                 lane.cc_inflight = False
-            self.set_task_ready(lane.node.cluster_id)
+            self.set_task_ready(lane.key)
         # ---- phase 5: confirmed reads ------------------------------------
         ready_gs = np.nonzero(o["ready_count"])[0]
         for g in ready_gs.tolist():
@@ -1413,8 +1530,11 @@ class VectorEngine:
                             )
                         )
             node.pending_read_indexes.applied(node.sm.last_applied_index())
+        prof.end("apply")
         # ---- phase 6: maintenance ----------------------------------------
+        prof.start()
         self._maintain(o)
+        prof.end("maintain")
 
     def _mk_vote(self, lane, o, g, p, to_nid) -> Message:
         return Message(
@@ -1679,7 +1799,7 @@ class VectorEngine:
                             reject=True,
                         )
                     )
-                    self.set_node_ready(lane.node.cluster_id)
+                    self.set_node_ready(lane.key)
         for p in done:
             lane.snap_inflight.pop(p, None)
         if not lane.snap_inflight:
@@ -1803,7 +1923,9 @@ class VectorEngine:
                 batch = []
             try:
                 kind = op[0]
-                if kind == "deactivate":
+                if kind == "barrier":
+                    op[1].set()
+                elif kind == "deactivate":
                     self._deactivate(op[1])
                 elif kind == "membership":
                     self._reconcile_membership(op[1])
@@ -1828,8 +1950,11 @@ class VectorEngine:
             )
 
     def _lane_of(self, node) -> Optional[_Lane]:
+        lane = node._vec_lane
+        if lane is None:
+            return None
         with self._lanes_mu:
-            return self._lanes.get(node.cluster_id)
+            return lane if self._lanes.get(lane.key) is lane else None
 
     def _compute_activation(self, lane: _Lane) -> Optional[dict]:
         """Host-side half of lane bring-up: bootstrap (initial start),
@@ -2281,7 +2406,7 @@ class VectorEngine:
         lane.recovering = False
         # persist the post-restore hard state and ack the leader so its
         # remote leaves the Snapshot state (raft.go handleInstallSnapshot)
-        self._logdb.save_raft_state(
+        node.logdb.save_raft_state(
             [
                 Update(
                     cluster_id=node.cluster_id,
@@ -2358,7 +2483,68 @@ class VectorEngine:
                     self._m_snap_pending[lane.g] = False
 
     # --------------------------------------------------------------- control
+    def profile_summary(self) -> dict:
+        return self.profiler.summary()
+
+    def leader_snapshot(self) -> Dict[tuple, Tuple[int, int]]:
+        """One vectorized pass over the numpy mirrors: lane key ->
+        (leader_node_id, term) for every active lane. Replaces per-group
+        get_leader_id polling at fleet bring-up (50k lanes = one call)."""
+        out: Dict[tuple, Tuple[int, int]] = {}
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+        leader = self._m_leader
+        term = self._m_term
+        for lane in lanes:
+            if not lane.active:
+                continue
+            g = lane.g
+            out[lane.key] = (
+                lane.rev.get(int(leader[g]) - 1, 0), int(term[g])
+            )
+        return out
+
+    def attach_host(self) -> int:
+        with self._hosts_mu:
+            host = self._next_host
+            self._next_host += 1
+            self._host_refs.add(host)
+        return host
+
+    def release(self, host: int) -> None:
+        """Detach one NodeHost handle; the core stops when the last handle
+        releases (a shared core outlives any single host). The last-ref
+        check and the registry removal happen under _shared_mu so a
+        concurrent get_vector_engine() can never attach to a core that is
+        about to stop. A non-last release drains the loop once so the
+        departing host's lanes are fully deactivated before its NodeHost
+        closes the logdb under them."""
+        with _shared_mu:
+            with self._hosts_mu:
+                self._host_refs.discard(host)
+                self._blocked_hosts.discard(host)
+                last = not self._host_refs
+            if last:
+                _forget_shared_core_locked(self)
+        if last:
+            self.stop()
+        else:
+            self._drain()
+
+    def _drain(self, timeout: float = 30.0) -> None:
+        """Block until the loop has applied every queued reconcile (incl.
+        deactivations) and finished its in-flight iteration."""
+        if self._stopped.is_set():
+            return
+        ev = threading.Event()
+        self._reconq.append(("barrier", ev))
+        self._ready.set()
+        ev.wait(timeout)
+
     def stop(self) -> None:
+        rep = self.profiler.report()
+        if rep:
+            _plog.infof("vector engine stage profile:\n%s", rep)
         self._stopped.set()
         self._ready.set()
         self.task_ready.wake_all()
@@ -2371,4 +2557,127 @@ class VectorEngine:
             t.join(timeout=30 if t.name == "vec-step" else 2)
 
 
-__all__ = ["VectorEngine", "VectorNode"]
+class VectorEngineHandle:
+    """Per-NodeHost facade over a (possibly shared) VectorEngine core.
+
+    Lanes inside the core are keyed (host, cluster_id); the handle carries
+    the host id so the Node/NodeHost side keeps addressing the engine by
+    bare cluster_id. Attribute access falls through to the core, so the
+    VectorNode status mirrors (_m_leader etc.) and the reconcile bridges
+    work unchanged."""
+
+    __slots__ = ("core", "host", "kcfg", "clock")
+
+    def __init__(self, core: VectorEngine, host: int) -> None:
+        self.core = core
+        self.host = host
+        self.kcfg = core.kcfg
+        self.clock = core.clock
+
+    def add_node(self, node) -> None:
+        self.core.add_node(node, self.host)
+
+    def remove_node(self, cluster_id: int) -> None:
+        self.core.remove_node((self.host, cluster_id))
+
+    def get_node(self, cluster_id: int):
+        return self.core.get_node((self.host, cluster_id))
+
+    def set_node_ready(self, cluster_id: int) -> None:
+        self.core.set_node_ready((self.host, cluster_id))
+
+    def set_task_ready(self, cluster_id: int) -> None:
+        self.core.set_task_ready((self.host, cluster_id))
+
+    def set_snapshot_ready(self, cluster_id: int) -> None:
+        self.core.set_snapshot_ready((self.host, cluster_id))
+
+    def global_tick(self) -> None:
+        self.core.global_tick(self.host)
+
+    def try_local_deliver(self, m: Message) -> bool:
+        return self.core.try_local_deliver(m)
+
+    def set_host_partitioned(self, partitioned: bool) -> None:
+        self.core.set_host_partitioned(self.host, partitioned)
+
+    def leader_snapshot(self) -> Dict[int, Tuple[int, int]]:
+        """cluster_id -> (leader_node_id, term) for this host's lanes."""
+        return {
+            key[1]: v
+            for key, v in self.core.leader_snapshot().items()
+            if key[0] == self.host
+        }
+
+    def stop(self) -> None:
+        self.core.release(self.host)
+
+    def __getattr__(self, name):
+        return getattr(self.core, name)
+
+
+# process-global registry of shared cores (EngineConfig.share_scope)
+_shared_mu = threading.Lock()
+_shared_cores: Dict[str, VectorEngine] = {}
+
+
+def get_vector_engine(logdb, nh_config: NodeHostConfig) -> VectorEngineHandle:
+    """Engine factory for NodeHost: returns a handle on a fresh core, or on
+    the process-shared core named by EngineConfig.share_scope (co-hosted
+    replicas then advance in ONE kernel step and exchange messages without
+    touching the transport)."""
+    scope = getattr(nh_config.engine, "share_scope", None)
+    if scope is None:
+        core = VectorEngine(logdb, nh_config=nh_config)
+        return VectorEngineHandle(core, core.attach_host())
+    with _shared_mu:
+        core = _shared_cores.get(scope)
+        if core is None:
+            core = _shared_cores[scope] = VectorEngine(
+                logdb, nh_config=nh_config
+            )
+        else:
+            want = nh_config.engine
+            mismatches = [
+                name
+                for name, got, exp in (
+                    ("max_groups", core.kcfg.groups, want.max_groups),
+                    ("max_peers", core.kcfg.peers, want.max_peers),
+                    ("log_window", core.kcfg.log_window, want.log_window),
+                    ("inbox_depth", core.kcfg.inbox_depth, want.inbox_depth),
+                    (
+                        "max_entries_per_msg",
+                        core.kcfg.max_entries_per_msg,
+                        getattr(want, "max_entries_per_msg", 8),
+                    ),
+                    (
+                        "readindex_depth",
+                        core.kcfg.readindex_depth,
+                        want.readindex_depth,
+                    ),
+                )
+                if got != exp
+            ]
+            if mismatches:
+                raise ValueError(
+                    f"share_scope {scope!r}: engine shape mismatch on "
+                    f"{mismatches} (every co-hosted NodeHost must declare "
+                    f"the same EngineConfig shapes)"
+                )
+        host = core.attach_host()
+    return VectorEngineHandle(core, host)
+
+
+def _forget_shared_core_locked(core: VectorEngine) -> None:
+    """Caller holds _shared_mu."""
+    for k, v in list(_shared_cores.items()):
+        if v is core:
+            del _shared_cores[k]
+
+
+__all__ = [
+    "VectorEngine",
+    "VectorEngineHandle",
+    "VectorNode",
+    "get_vector_engine",
+]

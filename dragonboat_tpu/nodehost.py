@@ -137,9 +137,9 @@ class NodeHost(IMessageHandler):
         )  # cap concurrent outbound streams (cf. StreamConnections)
         # --- engine
         if cfg.engine.kind == "vector":
-            from .engine.vector import VectorEngine
+            from .engine.vector import get_vector_engine
 
-            self.engine = VectorEngine(self.logdb, nh_config=cfg)
+            self.engine = get_vector_engine(self.logdb, cfg)
         else:
             self.engine = ExecEngine(self.logdb)
         # --- tick loop
@@ -537,6 +537,11 @@ class NodeHost(IMessageHandler):
         """Partition mode: drop ALL inbound and outbound raft traffic
         (cf. monkey.go:169-198)."""
         self._partitioned = partitioned
+        # co-hosted delivery bypasses the transport, so the engine core
+        # must drop inbound traffic for this host too
+        gate = getattr(self.engine, "set_host_partitioned", None)
+        if gate is not None:
+            gate(partitioned)
 
     def is_partitioned(self) -> bool:
         return self._partitioned
@@ -561,6 +566,12 @@ class NodeHost(IMessageHandler):
             return
         if m.type == MessageType.INSTALL_SNAPSHOT:
             self._async_send_snapshot(m)
+            return
+        # co-hosted short-circuit: replicas living on this process's engine
+        # core receive directly (no codec, no transport thread); anything
+        # else rides the wire
+        deliver = getattr(self.engine, "try_local_deliver", None)
+        if deliver is not None and deliver(m):
             return
         self.transport.send(m)
 

@@ -398,16 +398,22 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
         )
         any_conf = jnp.any(conflict, axis=1)
         do_append = ok & any_conf
-        # write entries from the first conflicting index on
-        wmask = do_append[:, None] & e_valid & (e_idx >= first_conf[:, None])
-        slot = e_idx % W
-        # scatter via one-hot matmul-free approach: loop over E (static, small)
-        log_term = s.log_term
-        log_cc = s.log_is_cc
-        for e in range(E):
-            oh = jax.nn.one_hot(slot[:, e], W, dtype=bool) & wmask[:, e : e + 1]
-            log_term = jnp.where(oh, m["entry_terms"][:, e : e + 1], log_term)
-            log_cc = jnp.where(oh, m["entry_cc"][:, e : e + 1], log_cc)
+        # ring-slot write WITHOUT a per-entry loop: slot w receives absolute
+        # index i(w) = lo + ((w - lo) mod W) — the unique index in the
+        # written span congruent to w (nent <= E <= W guarantees at most
+        # one) — so the whole scatter is one (G,W) gather+select and the
+        # kernel cost is independent of E (the old form unrolled E one-hot
+        # scatters, which capped how many entries a message could carry)
+        w_idx = jnp.arange(W, dtype=i32)[None, :]
+        lo = jnp.where(do_append, first_conf, 1)
+        hi = prev + nent
+        i_w = lo[:, None] + jnp.mod(w_idx - lo[:, None], W)
+        written = do_append[:, None] & (i_w <= hi[:, None])
+        e_pos = jnp.clip(i_w - (prev[:, None] + 1), 0, E - 1)
+        terms_w = jnp.take_along_axis(m["entry_terms"], e_pos, axis=1)
+        cc_w = jnp.take_along_axis(m["entry_cc"], e_pos, axis=1)
+        log_term = jnp.where(written, terms_w, s.log_term)
+        log_cc = jnp.where(written, cc_w, s.log_is_cc)
         new_last = jnp.where(do_append, prev + nent, s.last_index)
         s = s._replace(
             log_term=log_term,
@@ -580,20 +586,19 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     room = s.last_index - s.first_index + 1 + nent <= W
     can_append = pok & room
     prop_base = jnp.where(can_append, s.last_index + 1, prop_base)
-    # append up to E entries at the current term
+    # append up to E entries at the current term — same loop-free ring-slot
+    # scatter as the Replicate path: slot w gets index lo + ((w - lo) mod W)
     if E > 0:
-        a_idx = s.last_index[:, None] + 1 + jnp.arange(E, dtype=i32)[None, :]
-        a_valid = (jnp.arange(E, dtype=i32)[None, :] < nent[:, None]) & can_append[
-            :, None
-        ]
-        slot = a_idx % W
-        log_term = s.log_term
-        log_cc = s.log_is_cc
         eff_cc = m["entry_cc"] & cc_allowed[:, None]
-        for e in range(E):
-            oh = jax.nn.one_hot(slot[:, e], W, dtype=bool) & a_valid[:, e : e + 1]
-            log_term = jnp.where(oh, s.term[:, None], log_term)
-            log_cc = jnp.where(oh, eff_cc[:, e : e + 1], log_cc)
+        w_idx = jnp.arange(W, dtype=i32)[None, :]
+        a_lo = s.last_index + 1
+        a_hi = s.last_index + nent
+        i_w = a_lo[:, None] + jnp.mod(w_idx - a_lo[:, None], W)
+        written = can_append[:, None] & (i_w <= a_hi[:, None])
+        e_pos = jnp.clip(i_w - a_lo[:, None], 0, E - 1)
+        cc_w = jnp.take_along_axis(eff_cc, e_pos, axis=1)
+        log_term = jnp.where(written, s.term[:, None], s.log_term)
+        log_cc = jnp.where(written, cc_w, s.log_is_cc)
         new_last = jnp.where(can_append, s.last_index + nent, s.last_index)
         s = s._replace(
             log_term=log_term,

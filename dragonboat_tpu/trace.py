@@ -53,7 +53,10 @@ STAGES = ("step", "fast_apply", "send", "save", "apply", "exec")
 
 class Profiler:
     """Per-worker stage profiler (cf. trace.go:98-162 profiler; stages match
-    the reference's propose/step/save/cs/exec breakdown plus our apply)."""
+    the reference's propose/step/save/cs/exec breakdown plus our apply).
+    Stage names are open-ended: the vector engine records its own pipeline
+    (pack/dev/place/send/save/apply/notify), the scalar engine the classic
+    set — samples are created on first use."""
 
     def __init__(self, sample_ratio: int = 16) -> None:
         self.ratio = max(1, sample_ratio)
@@ -75,7 +78,10 @@ class Profiler:
 
     def end(self, stage: str) -> None:
         if self.sampling and self._t0 is not None:
-            self.samples[stage].record(time.monotonic() - self._t0)
+            s = self.samples.get(stage)
+            if s is None:
+                s = self.samples[stage] = Sample(stage)
+            s.record(time.monotonic() - self._t0)
             self._t0 = None
 
     def report(self) -> str:
@@ -86,6 +92,25 @@ class Profiler:
                 f"p99={self.batched_groups.percentile(0.99):.0f}"
             )
         return "\n".join(lines)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Machine-readable stage costs (mean/p99 in seconds + sample n);
+        bench.py folds the top stages into its JSON line."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, s in self.samples.items():
+            if len(s):
+                out[name] = {
+                    "n": float(len(s)),
+                    "mean_s": s.mean(),
+                    "p99_s": s.percentile(0.99),
+                    "total_s": s.mean() * len(s) * self.ratio,
+                }
+        return out
+
+    def top_stages(self, k: int = 3) -> List[str]:
+        """Stage names by estimated total cost, descending."""
+        sm = self.summary()
+        return sorted(sm, key=lambda n: -sm[n]["total_s"])[:k]
 
 
 __all__ = ["Sample", "Profiler", "STAGES"]
