@@ -1,0 +1,109 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from dragonboat_tpu._jaxenv import maybe_pin_cpu
+maybe_pin_cpu()
+import tempfile, shutil
+import dragonboat_tpu.engine.vector as _vec
+from dragonboat_tpu.ops.kernel import make_step_fn as _orig_msf
+_vec.make_step_fn = lambda cfg, donate=True: _orig_msf(cfg, False)
+from bench import _bench_sm_class
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.transport.loopback import loopback_factory, _Registry
+
+G = 256
+sm_cls = _bench_sm_class()
+import time as _time
+_t00 = _time.monotonic()
+EVENTS = []
+class _EvListener:
+    def leader_updated(self, info):
+        EVENTS.append((round(_time.monotonic()-_t00,3), info.cluster_id, info.node_id, info.leader_id, info.term))
+    def __getattr__(self, name):
+        def noop(*a, **k): pass
+        return noop
+reg = _Registry()
+members = {1:"b:1",2:"b:2",3:"b:3"}
+wd = tempfile.mkdtemp(prefix="dbtpu-w-")
+hosts = {}
+for nid, addr in members.items():
+    hosts[nid] = NodeHost(NodeHostConfig(
+        raft_address=addr, rtt_millisecond=10,
+        nodehost_dir=os.path.join(wd, f"nh{nid}"),
+        raft_rpc_factory=lambda a: loopback_factory(a, reg),
+        raft_event_listener=_EvListener(),
+        engine=EngineConfig(kind="vector", max_groups=3*G, max_peers=4,
+            log_window=256, inbox_depth=4, max_entries_per_msg=64,
+            share_scope="bench")))
+for c in range(1, G+1):
+    for nid in members:
+        hosts[nid].start_cluster(dict(members), False,
+            lambda cid, n: sm_cls(cid, n),
+            Config(node_id=nid, cluster_id=c, election_rtt=100, heartbeat_rtt=20))
+t0 = time.monotonic()
+leaders = {}
+while len(leaders) < G and time.monotonic()-t0 < 120:
+    snap = hosts[1].engine.leader_snapshot()
+    leaders = {c: l for c, (l, _t) in snap.items() if l}
+    time.sleep(0.05)
+print("bring_up", round(time.monotonic()-t0,2), flush=True)
+cmd = b"x"*16
+sessions = {c: hosts[leaders[c]].get_noop_session(c) for c in leaders}
+for wv in range(3):
+    t0 = time.perf_counter()
+    outstanding = {}
+    for c, sess in sessions.items():
+        rss = hosts[leaders[c]].propose_batch(sess, [cmd]*128, 30)
+        outstanding[c] = rss
+    t_sub = time.perf_counter()
+    # wait all
+    deadline = time.perf_counter() + 30
+    done_at = {}
+    while time.perf_counter() < deadline:
+        pendg = [c for c in outstanding if outstanding[c][-1].result is None]
+        if not pendg:
+            break
+        outstanding[pendg[0]][-1].wait(0.2)
+    t_done = time.perf_counter()
+    ok = sum(1 for rss in outstanding.values() for rs in rss if rs.result and rs.result.completed)
+    bad = {c: sum(1 for rs in rss if not (rs.result and rs.result.completed)) for c, rss in outstanding.items()}
+    bad = {c: n for c, n in bad.items() if n}
+    print(f"wave {wv}: submit={t_sub-t0:.2f}s complete={t_done-t0:.2f}s ok={ok} bad_groups={len(bad)}", flush=True)
+    if bad:
+        import numpy as _np
+        core = hosts[1].engine.core
+        st_dev = core._state
+        items = list(bad.items())[:2]
+        for c, n in items:
+            for nid in (1,2,3):
+                lane = core._route.get((c, nid))
+                if lane is None: continue
+                g = lane.g
+                print(f"  group {c} miss {n} replica {nid} g={g} role={int(core._m_role[g])} "
+                      f"term={int(core._m_term[g])} last={int(_np.asarray(st_dev.last_index[g]))} "
+                      f"commit={int(_np.asarray(st_dev.committed[g]))} "
+                      f"match={_np.asarray(st_dev.match[g]).tolist()} "
+                      f"next={_np.asarray(st_dev.next[g]).tolist()} "
+                      f"rstate={_np.asarray(st_dev.rstate[g]).tolist()} "
+                      f"backlog={len(lane.msg_backlog)} applied={lane.node.sm.last_applied_index()}", flush=True)
+    # refresh leaders
+    snap = hosts[1].engine.leader_snapshot()
+    for c,(l,_t) in snap.items():
+        if l: leaders[c] = l
+print("leader_updated events:", len(EVENTS), flush=True)
+from collections import Counter
+per_cluster = Counter(e[1] for e in EVENTS)
+noisy = per_cluster.most_common(5)
+print("noisiest clusters:", noisy, flush=True)
+for c, _n in noisy[:2]:
+    print(" cluster", c, [e for e in EVENTS if e[1]==c][-12:], flush=True)
+import numpy as _np
+ts = _np.array([e[0] for e in EVENTS])
+print("events by 5s bucket:", _np.histogram(ts, bins=_np.arange(0, ts.max()+5, 5))[0].tolist() if len(ts) else [], flush=True)
+core = hosts[1].engine.core
+prof = core.profile_summary()
+for name, d in sorted(prof.items(), key=lambda kv: -kv[1]["total_s"]):
+    print(f"  {name:10s} n={int(d['n']):6d} mean={d['mean_s']*1e6:9.1f}us p99={d['p99_s']*1e6:9.1f}us total={d['total_s']:6.2f}s", flush=True)
+for nh in hosts.values(): nh.stop()
+shutil.rmtree(wd, ignore_errors=True)

@@ -1,0 +1,88 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from dragonboat_tpu._jaxenv import maybe_pin_cpu
+maybe_pin_cpu()
+import tempfile, shutil
+from bench import _bench_sm_class
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.transport.loopback import loopback_factory, _Registry
+
+G = 256
+WAVE = 128
+sm_cls = _bench_sm_class()
+reg = _Registry()
+members = {1:"b:1",2:"b:2",3:"b:3"}
+wd = tempfile.mkdtemp(prefix="dbtpu-w-")
+hosts = {}
+for nid, addr in members.items():
+    hosts[nid] = NodeHost(NodeHostConfig(
+        raft_address=addr, rtt_millisecond=10,
+        nodehost_dir=os.path.join(wd, f"nh{nid}"),
+        raft_rpc_factory=lambda a: loopback_factory(a, reg),
+        engine=EngineConfig(kind="vector", max_groups=3*G, max_peers=4,
+            log_window=256, inbox_depth=4, max_entries_per_msg=64,
+            share_scope="bench")))
+for c in range(1, G+1):
+    for nid in members:
+        hosts[nid].start_cluster(dict(members), False,
+            lambda cid, n: sm_cls(cid, n),
+            Config(node_id=nid, cluster_id=c, election_rtt=100, heartbeat_rtt=20))
+t0 = time.monotonic()
+leaders = {}
+while len(leaders) < G and time.monotonic()-t0 < 120:
+    snap = hosts[1].engine.leader_snapshot()
+    leaders = {c: l for c, (l, _t) in snap.items() if l}
+    time.sleep(0.05)
+print("bring_up", round(time.monotonic()-t0,2), flush=True)
+# timeline: wrap the core loop's _run_once
+core = hosts[1].engine.core
+TL = []
+_orig_run = type(core)._run_once
+def timed_run(self):
+    t0 = time.perf_counter()
+    _orig_run(self)
+    TL.append((t0, time.perf_counter()-t0))
+import types
+core._run_once = types.MethodType(timed_run, core)
+time.sleep(3)  # let post-bring-up churn settle fully
+cmd = b"x"*16
+sessions = {c: hosts[leaders[c]].get_noop_session(c) for c in leaders}
+for wv in range(2):
+    t0 = time.perf_counter()
+    outstanding = []
+    for c, sess in sessions.items():
+        outstanding.extend(hosts[leaders[c]].propose_batch(sess, [cmd]*WAVE, 30))
+    t_sub = time.perf_counter() - t0
+    N = len(outstanding)
+    curve = []
+    while time.perf_counter() - t0 < 25:
+        done = sum(1 for rs in outstanding if rs.result is not None)
+        curve.append((round(time.perf_counter()-t0,2), done))
+        if done == N: break
+        time.sleep(0.25)
+    ok = sum(1 for rs in outstanding if rs.result and rs.result.completed)
+    # thin the curve for printing: first time crossing each decile
+    deciles = []
+    seen = -1
+    for t, d in curve:
+        dec = (10*d)//N
+        if dec > seen:
+            deciles.append((t, d)); seen = dec
+    print(f"wave {wv}: submit={t_sub:.2f}s n={N} ok={ok} curve={deciles} end={curve[-1]}", flush=True)
+    import numpy as _np
+    tl = [(t - t0, d) for t, d in TL if t >= t0]
+    durs = _np.array([d for _, d in tl])
+    starts = _np.array([t for t, _ in tl])
+    gaps = _np.diff(starts) - durs[:-1] if len(tl) > 1 else _np.array([0.0])
+    print(f"  steps={len(tl)} dur: mean={durs.mean()*1e3:.1f}ms p99={_np.percentile(durs,99)*1e3:.1f}ms max={durs.max()*1e3:.1f}ms; "
+          f"idle gaps: max={gaps.max()*1e3:.1f}ms total={gaps.sum():.2f}s; busy={durs.sum():.2f}s", flush=True)
+    big = sorted(tl, key=lambda x: -x[1])[:6]
+    print("  slowest steps at:", [(round(t,2), round(d*1e3)) for t, d in big], flush=True)
+    TL.clear()
+    snap = hosts[1].engine.leader_snapshot()
+    for c,(l,_t) in snap.items():
+        if l: leaders[c] = l
+for nh in hosts.values(): nh.stop()
+shutil.rmtree(wd, ignore_errors=True)

@@ -1,0 +1,37 @@
+import sys, time, tempfile
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+class CounterSM(IStateMachine):
+    def __init__(self, *a): self.n = 0
+    def update(self, data): self.n += 1; return Result(value=self.n)
+    def lookup(self, q): return self.n
+    def save_snapshot(self, w, fc, done): w.write(self.n.to_bytes(8,'little'))
+    def recover_from_snapshot(self, r, fc, done): self.n = int.from_bytes(r.read(8),'little')
+    def close(self): pass
+wd = tempfile.mkdtemp()
+reg = _Registry()
+nh = NodeHost(NodeHostConfig(deployment_id=88, rtt_millisecond=5, raft_address="pb1:1",
+    nodehost_dir=wd, raft_rpc_factory=lambda l: loopback_factory(l, reg),
+    engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4, log_window=64)))
+nh.start_cluster({1: "pb1:1"}, False, lambda c, n: CounterSM(),
+    Config(cluster_id=1, node_id=1, election_rtt=20, heartbeat_rtt=2))
+t0=time.time()
+while time.time()-t0 < 60:
+    _, ok = nh.get_leader_id(1)
+    if ok: break
+    time.sleep(0.02)
+s = nh.get_noop_session(1)
+rss = nh.propose_batch(s, [b"x%d" % i for i in range(50)], 30.0)
+results = [rs.wait(10.0) for rs in rss]
+from collections import Counter
+print("codes:", Counter(r.code for r in results))
+print("values:", [r.result.value for r in results][:55])
+print("stale:", nh.stale_read(1, None))
+nh.stop()

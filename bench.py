@@ -119,24 +119,36 @@ from dragonboat_tpu.ops.state import (
 
 
 def _bench_sm_class():
-    from dragonboat_tpu.statemachine import IStateMachine, Result
+    from dragonboat_tpu.statemachine import (
+        IConcurrentStateMachine,
+        Result,
+    )
 
-    class _BenchSM(IStateMachine):
+    class _BenchSM(IConcurrentStateMachine):
         """Minimal in-memory counter SM (the reference benches an in-mem
-        KV, internal/tests/kvtest.go)."""
+        KV, internal/tests/kvtest.go). Concurrent flavour: update() takes
+        the whole committed batch in ONE call — the apply-side shape a
+        throughput-focused SM should use on this framework."""
 
         def __init__(self, cluster_id, node_id):
             self.n = 0
 
-        def update(self, data):
-            self.n += 1
-            return Result(value=self.n)
+        def update(self, entries):
+            n = self.n
+            for e in entries:
+                n += 1
+                e.result = Result(value=n)
+            self.n = n
+            return entries
 
         def lookup(self, q):
             return self.n
 
-        def save_snapshot(self, w, fc, done):
-            w.write(self.n.to_bytes(8, "little"))
+        def prepare_snapshot(self):
+            return self.n
+
+        def save_snapshot(self, ctx, w, fc, done):
+            w.write(int(ctx).to_bytes(8, "little"))
 
         def recover_from_snapshot(self, r, fc, done):
             self.n = int.from_bytes(r.read(8), "little")
@@ -147,8 +159,24 @@ def _bench_sm_class():
     return _BenchSM
 
 
-def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
-    """3 NodeHosts, G groups x 3 replicas, quorum + fsync + apply."""
+def bench_e2e(
+    groups: int,
+    duration_s: float,
+    payload: int,
+    workdir: str,
+    shared: bool = True,
+    wave: int = 128,
+    inbox_depth: int = 4,
+    entries_per_msg: int = 64,
+    log_window: int = 256,
+):
+    """3 NodeHosts, G groups x 3 replicas, quorum + fsync + apply.
+
+    shared=True co-hosts all three NodeHosts on ONE engine core (the
+    TPU-native deployment shape: the whole replica fleet advances in one
+    kernel step; messages between replicas ride the shared inbox, not the
+    wire). shared=False keeps three independent engines talking over the
+    codec-encoded loopback transport."""
     from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
     from dragonboat_tpu.nodehost import NodeHost
     from dragonboat_tpu.statemachine import Result  # noqa: F401 (SM dep)
@@ -172,9 +200,12 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
             raft_rpc_factory=lambda a: loopback_factory(a, reg),
             engine=EngineConfig(
                 kind="vector",
-                max_groups=groups,
+                max_groups=3 * groups if shared else groups,
                 max_peers=4,
-                log_window=128,
+                log_window=log_window,
+                inbox_depth=inbox_depth,
+                max_entries_per_msg=entries_per_msg,
+                share_scope="bench" if shared else None,
             ),
         )
         hosts[nid] = NodeHost(cfg)
@@ -189,18 +220,28 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
                     heartbeat_rtt=20,
                 ),
             )
-    # wait for every group to elect a leader
+    # wait for every group to elect a leader — ONE vectorized leadership
+    # readout per poll instead of per-group get_leader_id calls
     t0 = time.monotonic()
     leaders = {}
     pending = set(range(1, groups + 1))
+    snap_fn = getattr(hosts[1].engine, "leader_snapshot", None)
     while pending and time.monotonic() - t0 < 180:
-        done = set()
-        for c in pending:
-            lid, ok = hosts[1].get_leader_id(c)
-            if ok:
-                leaders[c] = lid
-                done.add(c)
-        pending -= done
+        if snap_fn is not None:
+            snap = snap_fn()
+            for c in list(pending):
+                lid, _term = snap.get(c, (0, 0))
+                if lid:
+                    leaders[c] = lid
+                    pending.discard(c)
+        else:
+            done = set()
+            for c in pending:
+                lid, ok = hosts[1].get_leader_id(c)
+                if ok:
+                    leaders[c] = lid
+                    done.add(c)
+            pending -= done
         if pending:
             time.sleep(0.05)
     bring_up_s = time.monotonic() - t0
@@ -212,54 +253,53 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
     sessions = {
         c: hosts[leaders[c]].get_noop_session(c) for c in range(1, groups + 1)
     }
-    # pipelined waves: WAVE proposals per group in flight, wait, repeat
-    # (32 = 4 full inbox rows of 8 entries per lane per step; commits for
-    # the whole wave ride one quorum round, amortizing the step latency).
-    # Pacing waits only on each group's LAST proposal — a straggler lost
-    # to leadership churn must not serialize the wave behind its timeout;
-    # completions are counted non-blocking at the end of the next wave.
-    WAVE = 32
+    # per-group pipelined batches: each group keeps ONE async batch of WAVE
+    # proposals in flight (propose_batch_async: one handle + one event per
+    # batch); a group resubmits the moment its batch completes. There is no
+    # global barrier, so a group wedged by leadership churn costs only its
+    # own lane while every other group keeps streaming — the shape of the
+    # reference's pipelined benchmark clients.
+    WAVE = wave
     total = 0
-    pending_count: list = []
+    dropped = 0
+    inflight: dict = {}
+    wave_cmds = [cmd] * WAVE
     t0 = time.perf_counter()
     deadline = t0 + duration_s
-    wave_cmds = [cmd] * WAVE
+    next_leader_refresh = t0 + 0.5
     while time.perf_counter() < deadline:
-        outstanding = []
-        last_per_group = []
+        progressed = False
         for c, sess in sessions.items():
-            nh = hosts[leaders[c]]
-            # batch submission: one registry/queue lock round-trip per
-            # group per wave instead of WAVE of them — the per-proposal
-            # Python overhead is the submission-side ceiling
-            rss = nh.propose_batch(sess, wave_cmds, 30)
-            outstanding.extend(rss)
-            last_per_group.append(rss[-1])
-        for rs in last_per_group:
-            rs.wait(timeout=5)
-        done = 0
-        still = []
-        for rs in outstanding:  # one pass: a result landing between two
-            r = rs.result       # scans must not vanish from both buckets
-            if r is not None and r.completed:
-                done += 1
-            elif r is None:
-                still.append(rs)
-        total += done
-        pending_count.append(still)
-        # refresh leadership for the next wave (churn under load moves it)
-        for c in sessions:
-            lid, ok = hosts[1].get_leader_id(c)
-            if ok:
-                leaders[c] = lid
-    # late completions from the last waves
-    t_settle = time.perf_counter()
-    for batch in pending_count:
-        for rs in batch:
-            if rs.result is None and time.perf_counter() - t_settle < 10:
-                rs.wait(timeout=0.2)
-            if rs.result and rs.result.completed:
-                total += 1
+            h = inflight.get(c)
+            if h is not None:
+                if not h.finished:
+                    continue
+                total += h.completed
+                dropped += h.dropped
+            inflight[c] = hosts[leaders[c]].propose_batch_async(
+                sess, wave_cmds, 15
+            )
+            progressed = True
+        now = time.perf_counter()
+        if now >= next_leader_refresh:
+            next_leader_refresh = now + 0.5
+            if snap_fn is not None:
+                for c, (lid, _t) in snap_fn().items():
+                    if lid and c in sessions:
+                        leaders[c] = lid
+            else:
+                for c in sessions:
+                    lid, ok = hosts[1].get_leader_id(c)
+                    if ok:
+                        leaders[c] = lid
+        if not progressed:
+            time.sleep(0.002)
+    # settle the last in-flight batch per group (bounded)
+    settle_deadline = time.perf_counter() + 10
+    for c, h in inflight.items():
+        h.wait(max(0.0, settle_deadline - time.perf_counter()))
+        total += h.completed
+        dropped += h.dropped
     dt = time.perf_counter() - t0
     for nh in hosts.values():
         nh.stop()
@@ -269,9 +309,12 @@ def bench_e2e(groups: int, duration_s: float, payload: int, workdir: str):
         "replicas": 3,
         "payload_bytes": payload,
         "committed": total,
+        "client_dropped": dropped,
         "seconds": round(dt, 2),
         "bring_up_s": round(bring_up_s, 2),
         "fsync": True,
+        "shared_engine": shared,
+        "wave": wave,
     }
 
 

@@ -146,6 +146,12 @@ def encode_entries(entries: List[Entry]) -> bytes:
     return b"".join(parts)
 
 
+def join_encoded_entries(parts: List[bytes]) -> bytes:
+    """Assemble an entry-list record from per-entry encode_entry() outputs
+    (the logdb batch cache keeps those parts to avoid re-encoding)."""
+    return _U32.pack(len(parts)) + b"".join(parts)
+
+
 @_checked
 def decode_entries(buf, off: int = 0) -> Tuple[List[Entry], int]:
     n, off = _unpack_count(buf, off, _ENTRY.size)

@@ -17,10 +17,13 @@ from ..config import Config
 from ..core.peer import Peer, PeerAddress, encode_config_change
 from ..core.logentry import ErrCompacted
 from ..requests import (
+    BATCH_KEY_BIT,
+    BatchRequestState,
     ErrClusterClosed,
     ErrInvalidSession,
     ErrPayloadTooBig,
     ErrSystemBusy,
+    ErrTimeoutTooSmall,
     LogicalClock,
     PendingConfigChange,
     PendingLeaderTransfer,
@@ -28,6 +31,9 @@ from ..requests import (
     PendingReadIndex,
     PendingSnapshot,
     RequestState,
+    batch_id_of,
+    make_batch_id,
+    make_batch_key,
 )
 from ..rsm.encoded import maybe_encode_entry
 from ..rsm import (
@@ -88,6 +94,11 @@ class Node:
         self.pending_leader_transfer = PendingLeaderTransfer()
         self.incoming_proposals = EntryQueue(soft.incoming_proposal_queue_length)
         self.incoming_reads = ReadIndexQueue(soft.incoming_read_index_queue_length)
+        # batch-tracked proposals (propose_batch_async): ONE handle per
+        # submission, completion routed by the key's (batch_id, seq)
+        self._batch_mu = threading.Lock()
+        self._batches: dict = {}  # batch_id -> BatchRequestState
+        self._batch_seq = 0
         self.mq = MessageQueue(soft.received_message_queue_length)
         self.quiesce_mgr = QuiesceManager(
             enabled=cfg.quiesce, election_tick=cfg.election_rtt
@@ -157,11 +168,61 @@ class Node:
         self.engine.set_node_ready(self.cluster_id)
 
     def apply_update(self, entry, result, rejected, ignored, notify_read) -> None:
-        self.pending_proposals.applied(
-            entry.key, entry.client_id, entry.series_id, result, rejected
-        )
+        if entry.key & BATCH_KEY_BIT:
+            self._batch_applied(batch_id_of(entry.key), 1)
+        else:
+            self.pending_proposals.applied(
+                entry.key, entry.client_id, entry.series_id, result, rejected
+            )
         if notify_read:
             self.pending_read_indexes.applied(entry.index)
+
+    def apply_update_run(self, entries, results=None) -> None:
+        """Run-level completion for a contiguous batch of plain applied
+        entries (the RSM manager's fast path): batch-tracked proposals
+        complete per (batch_id, count) instead of per entry. `results`
+        aligns with `entries`; None means no per-request keys exist in the
+        run (the manager skips result realignment for pure batch runs)."""
+        counts: dict = {}
+        if results is None and not self._batches:
+            return  # replica apply with no locally-tracked batches
+        if results is None:
+            for e in entries:
+                if e.key & BATCH_KEY_BIT:
+                    bid = batch_id_of(e.key)
+                    counts[bid] = counts.get(bid, 0) + 1
+        else:
+            for e, r in zip(entries, results):
+                if e.key & BATCH_KEY_BIT:
+                    bid = batch_id_of(e.key)
+                    counts[bid] = counts.get(bid, 0) + 1
+                elif e.key:
+                    self.pending_proposals.applied(
+                        e.key, e.client_id, e.series_id, r, False
+                    )
+        for bid, n in counts.items():
+            self._batch_applied(bid, n)
+
+    def _batch_applied(self, batch_id: int, n: int) -> None:
+        with self._batch_mu:
+            h = self._batches.get(batch_id)
+        if h is None:
+            return  # submitted elsewhere (replica apply) or already expired
+        h.add_done(completed=n)
+        if h.finished:
+            with self._batch_mu:
+                self._batches.pop(batch_id, None)
+
+    def proposal_dropped(self, entry) -> None:
+        """Drop notification that understands batch-tracked keys (the
+        engine calls this instead of pending_proposals.dropped directly)."""
+        if entry.key & BATCH_KEY_BIT:
+            with self._batch_mu:
+                h = self._batches.get(batch_id_of(entry.key))
+            if h is not None:
+                h.add_done(dropped=1)
+        else:
+            self.pending_proposals.dropped(entry.key)
 
     def apply_config_change(self, cc: ConfigChange) -> None:
         """Called by the RSM when a config change commits; updates the
@@ -233,6 +294,70 @@ class Node:
         if accepted:
             self.engine.set_node_ready(self.cluster_id)
         return rss
+
+    def propose_batch_async(
+        self, session: Session, cmds, timeout_ticks: int
+    ) -> BatchRequestState:
+        """Fire-and-collect batch submission: ONE handle, ONE completion
+        event for the whole batch; per-proposal results are not retained
+        (use propose/propose_batch when they matter). No-op sessions only.
+        The entries carry (batch_id, seq) in their key, so completion
+        survives host-side forwarding and leader changes."""
+        cmds = list(cmds)
+        if not session.is_noop_session():
+            raise ErrInvalidSession()
+        if timeout_ticks < 1:
+            raise ErrTimeoutTooSmall()
+        for cmd in cmds:
+            if len(cmd) > soft.max_proposal_payload_size:
+                raise ErrPayloadTooBig()
+        if self._rate_limited:
+            raise ErrSystemBusy()
+        with self._batch_mu:
+            if self.stopped:
+                raise ErrClusterClosed()
+            self._batch_seq += 1
+            bid = make_batch_id(self._node_id, self._batch_seq)
+            h = BatchRequestState(
+                bid, len(cmds), self.clock.tick + timeout_ticks
+            )
+            self._batches[bid] = h
+        if not cmds:
+            h.expire()
+            return h
+        key0 = make_batch_key(bid, 0)
+        entries = [
+            Entry(
+                key=key0 + i,
+                client_id=session.client_id,
+                series_id=session.series_id,
+                responded_to=session.responded_to,
+                cmd=cmd,
+            )
+            for i, cmd in enumerate(cmds)
+        ]
+        if self.config.entry_compression_type:
+            for entry in entries:
+                maybe_encode_entry(self.config.entry_compression_type, entry)
+        accepted = self.incoming_proposals.add_many(entries)
+        if accepted < len(entries):
+            h.add_done(dropped=len(entries) - accepted)
+        if accepted:
+            self.engine.set_node_ready(self.cluster_id)
+        return h
+
+    def gc_batches(self) -> None:
+        """Expire timed-out batch handles (called from the tick/gc pass)."""
+        if not self._batches:
+            return
+        now = self.clock.tick
+        with self._batch_mu:
+            dead = [
+                bid for bid, h in self._batches.items() if h.deadline < now
+            ]
+            handles = [self._batches.pop(bid) for bid in dead]
+        for h in handles:
+            h.expire()
 
     def read(self, timeout_ticks: int) -> RequestState:
         rs = self.pending_read_indexes.read(timeout_ticks)
@@ -384,6 +509,7 @@ class Node:
             self.pending_read_indexes.gc()
             self.pending_config_change.gc()
             self.pending_snapshot.gc()
+            self.gc_batches()
         if self.quiesce_mgr.tick():
             self.peer.quiesced_tick()
         else:
@@ -392,7 +518,7 @@ class Node:
     # ----------------------------------------------- engine: update processing
     def process_dropped(self, ud: Update) -> None:
         for e in ud.dropped_entries:
-            self.pending_proposals.dropped(e.key)
+            self.proposal_dropped(e)
         for ctx in ud.dropped_read_indexes:
             self.pending_read_indexes.dropped(ctx)
 
@@ -701,6 +827,11 @@ class Node:
         self.pending_read_indexes.close()
         self.pending_config_change.close()
         self.pending_snapshot.close()
+        with self._batch_mu:
+            handles = list(self._batches.values())
+            self._batches.clear()
+        for h in handles:
+            h.expire()
         self.sm.offloaded()
 
 

@@ -319,55 +319,75 @@ class VectorNode(Node):
         self.engine.snapshot_status_ready(self)
 
 
-class _Arena(dict):
-    """Entry arena (real index -> Entry) that tracks its byte sizes, so
-    per-lane Config.max_in_mem_log_size enforcement costs O(1) at propose
-    time (cf. internal/server/rate.go + inmemory.go size accounting; the
-    arena is the vector engine's in-memory log tier).
+class _Arena:
+    """Entry arena over the device window: a RING of W slots indexed by
+    real index % W, so placement/lookup are list indexing (a dict per
+    index was a measured hot spot across place/send/save/apply) and
+    compaction is free — overwriting a slot IS the eviction, exactly when
+    the device window has moved past it.
 
-    Two counters: mem_bytes is everything resident; unapplied_bytes covers
-    only entries above the applied watermark — the real backpressure
-    signal, because applied entries stay in the arena merely as the device
-    window's payload cache (the scalar inmem drops them instead,
-    inmemory.go appliedLogTo)."""
+    Byte counters back per-lane Config.max_in_mem_log_size enforcement
+    (cf. internal/server/rate.go + inmemory.go size accounting; the arena
+    is the vector engine's in-memory log tier): mem_bytes is everything
+    resident; unapplied_bytes covers only entries above the applied
+    watermark — the real backpressure signal, because applied entries stay
+    resident merely as the window's payload cache (the scalar inmem drops
+    them instead, inmemory.go appliedLogTo)."""
 
-    __slots__ = ("mem_bytes", "unapplied_bytes", "applied")
+    __slots__ = ("w", "buf", "mem_bytes", "unapplied_bytes", "applied")
 
-    def __init__(self) -> None:
-        super().__init__()
+    def __init__(self, window: int) -> None:
+        self.w = window
+        self.buf: List[Optional[Entry]] = [None] * window
         self.mem_bytes = 0
         self.unapplied_bytes = 0
         self.applied = 0
 
-    def __setitem__(self, key, entry) -> None:
-        old = self.get(key)
+    def __setitem__(self, key: int, entry: Entry) -> None:
+        slot = key % self.w
+        old = self.buf[slot]
         sz = ENTRY_OVERHEAD_BYTES + len(entry.cmd)
         if old is not None:
             osz = ENTRY_OVERHEAD_BYTES + len(old.cmd)
             self.mem_bytes -= osz
-            if key > self.applied:
+            if old.index > self.applied:
                 self.unapplied_bytes -= osz
         self.mem_bytes += sz
         if key > self.applied:
             self.unapplied_bytes += sz
-        super().__setitem__(key, entry)
+        self.buf[slot] = entry
 
-    def __delitem__(self, key) -> None:
-        old = self.get(key)
-        if old is not None:
-            sz = ENTRY_OVERHEAD_BYTES + len(old.cmd)
-            self.mem_bytes -= sz
-            if key > self.applied:
-                self.unapplied_bytes -= sz
-        super().__delitem__(key)
+    def get(self, key: int) -> Optional[Entry]:
+        e = self.buf[key % self.w]
+        return e if e is not None and e.index == key else None
+
+    def __getitem__(self, key: int) -> Entry:
+        e = self.buf[key % self.w]
+        if e is None or e.index != key:
+            raise KeyError(key)
+        return e
+
+    def get_run(self, lo: int, hi: int):
+        """Entries [lo, hi] inclusive, or (None, missing_index) on a hole."""
+        w, buf = self.w, self.buf
+        out = []
+        for i in range(lo, hi + 1):
+            e = buf[i % w]
+            if e is None or e.index != i:
+                return None, i
+            out.append(e)
+        return out, -1
 
     def mark_applied(self, index: int) -> None:
         """Advance the applied watermark; entries in (applied, index] no
-        longer count toward unapplied_bytes. O(1) amortized per entry."""
+        longer count toward unapplied_bytes."""
+        w, buf = self.w, self.buf
+        dec = 0
         for i in range(self.applied + 1, index + 1):
-            e = self.get(i)
-            if e is not None:
-                self.unapplied_bytes -= ENTRY_OVERHEAD_BYTES + len(e.cmd)
+            e = buf[i % w]
+            if e is not None and e.index == i:
+                dec += ENTRY_OVERHEAD_BYTES + len(e.cmd)
+        self.unapplied_bytes -= dec
         if index > self.applied:
             self.applied = index
 
@@ -407,7 +427,8 @@ class _Lane:
         self.cfg: Config = node.config
         self.slots: Dict[int, int] = {}  # node_id -> slot
         self.rev: Dict[int, int] = {}  # slot -> node_id
-        self.arena: _Arena = _Arena()  # real index -> Entry, size-tracked
+        # ring over the device window; real index -> Entry, size-tracked
+        self.arena: _Arena = _Arena(node.engine.kcfg.log_window)
         self.staged_props: deque = deque()  # (Entry, is_local)
         self.staged_reads: deque = deque()  # RequestState
         self.staged_ccs: deque = deque()  # (Entry, key)
@@ -887,6 +908,7 @@ class VectorEngine:
             node.pending_read_indexes.gc()
             node.pending_config_change.gc()
             node.pending_snapshot.gc()
+            node.gc_batches()
             if lane.ri_pending:
                 # engine-side ctx routing entries die with their batches
                 # (timed-out forwarded reads would otherwise leak here)
@@ -903,6 +925,7 @@ class VectorEngine:
                 or node.pending_read_indexes.has_pending()
                 or node.pending_config_change.has_pending()
                 or node.pending_snapshot.has_pending()
+                or node._batches
             ):
                 drop.append(cid)
         if drop:
@@ -995,13 +1018,21 @@ class VectorEngine:
                                 entries=[ce],
                             )
                         )
-            # 3. proposals
+            # 3. proposals — throttled to the device window's free space so
+            # the kernel never has to drop for lack of room (minus 1 slot
+            # of slack for a concurrent new-leader noop append); what
+            # doesn't fit stays staged and re-packs after compaction
             if lane.staged_props:
                 if is_leader:
-                    while lane.staged_props and k < K:
+                    free = self.kcfg.log_window - 1 - int(
+                        self._m_last[g] - self._m_devfirst[g] + 1
+                    )
+                    while lane.staged_props and k < K and free > 0:
                         ents = []
-                        while lane.staged_props and len(ents) < E:
+                        cap = min(E, free)
+                        while lane.staged_props and len(ents) < cap:
                             ents.append(lane.staged_props.popleft()[0])
+                        free -= len(ents)
                         self._pack_row(
                             g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
                             n_entries=len(ents),
@@ -1276,7 +1307,7 @@ class VectorEngine:
                             lane.arena[e.index] = e
                     else:
                         for e in ents:
-                            node.pending_proposals.dropped(e.key)
+                            node.proposal_dropped(e)
                 elif kind == "cc":
                     ce, key = info[1], info[2]
                     pbase = int(o["prop_base"][g, k])
@@ -1383,15 +1414,13 @@ class VectorEngine:
             sf, st_ = int(o["save_from"][g]), int(o["save_to"][g])
             ents: List[Entry] = []
             if sf > 0:
-                for idx in range(b + sf, b + st_ + 1):
-                    e = lane.arena.get(idx)
-                    if e is None:
-                        _plog.errorf(
-                            "%s missing arena entry %d for save",
-                            lane.node.describe(), idx,
-                        )
-                        continue
-                    ents.append(e)
+                ents, missing_at = lane.arena.get_run(b + sf, b + st_)
+                if ents is None:
+                    _plog.errorf(
+                        "%s missing arena entry %d for save",
+                        lane.node.describe(), missing_at,
+                    )
+                    ents = []
             vote_slot = int(o["vote"][g])
             state = State(
                 term=int(o["term"][g]),
@@ -1470,19 +1499,14 @@ class VectorEngine:
                 continue
             b = int(base[g])
             af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
-            ents = []
-            missing = False
-            for idx in range(b + af, b + at + 1):
-                e = lane.arena.get(idx)
-                if e is None:
-                    _plog.errorf(
-                        "%s missing arena entry %d for apply",
-                        lane.node.describe(), idx,
-                    )
-                    missing = True
-                    break
-                ents.append(e)
-            if missing or not ents:
+            ents, missing_at = lane.arena.get_run(b + af, b + at)
+            if ents is None:
+                _plog.errorf(
+                    "%s missing arena entry %d for apply",
+                    lane.node.describe(), missing_at,
+                )
+                continue
+            if not ents:
                 continue
             lane.node.sm.task_queue.add(
                 Task(
@@ -1844,9 +1868,9 @@ class VectorEngine:
         # (catchup path) or a snapshot, so the device needs neither
         used = o["last_index"].astype(np.int64) - self._m_devfirst + 1
         compact_due = self._m_active & ((used > W // 2) | log_full)
-        advance_g: List[int] = []
-        advance_first: List[int] = []
-        advance_term: List[int] = []
+        adv_mask = np.zeros(self.kcfg.groups, bool)
+        adv_first = np.zeros(self.kcfg.groups, np.int32)
+        adv_term = np.zeros(self.kcfg.groups, np.int32)
         for g in np.nonzero(compact_due)[0].tolist():
             lane = lane_by_g[g]
             if lane is None:
@@ -1857,23 +1881,21 @@ class VectorEngine:
             if target + 1 > b + int(self._m_devfirst[g]):
                 first_new = target - b + 1
                 self._m_devfirst[g] = first_new
-                advance_g.append(g)
-                advance_first.append(first_new)
-                advance_term.append(applied_term)
-                # prune the arena below the window (payloads now live in
-                # logdb/log_reader only)
-                for idx in [i for i in lane.arena if i < target + 1]:
-                    del lane.arena[idx]
-        if advance_g:
-            gs = jnp.asarray(np.asarray(advance_g, np.int32))
+                adv_mask[g] = True
+                adv_first[g] = first_new
+                adv_term[g] = applied_term
+        if adv_mask.any():
+            # FIXED-SHAPE masked update: an .at[gs].set scatter would
+            # recompile for every distinct batch length (observed as
+            # 300-700ms step spikes under load — long enough to pile ticks
+            # and trigger spurious elections); whole-G where() compiles once
             s = self._state
+            m = jnp.asarray(adv_mask)
             self._state = s._replace(
-                first_index=s.first_index.at[gs].set(
-                    jnp.asarray(np.asarray(advance_first, np.int32))
+                first_index=jnp.where(
+                    m, jnp.asarray(adv_first), s.first_index
                 ),
-                marker_term=s.marker_term.at[gs].set(
-                    jnp.asarray(np.asarray(advance_term, np.int32))
-                ),
+                marker_term=jnp.where(m, jnp.asarray(adv_term), s.marker_term),
             )
         if bool(np.any(o["last_index"] > _REBASE_THRESHOLD)):
             self._do_rebase()
@@ -2337,7 +2359,7 @@ class VectorEngine:
             frozenset(mem.observers),
             frozenset(mem.witnesses),
         )
-        lane.arena = _Arena()
+        lane.arena = _Arena(self.kcfg.log_window)
         # everything at or below the installed snapshot is applied; seeding
         # the watermark keeps the next phase-4 mark_applied from walking
         # the whole history from zero (same as the activation path)

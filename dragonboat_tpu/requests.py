@@ -111,6 +111,77 @@ class RequestResult:
         return self.code == REQUEST_DROPPED
 
 
+# ---------------------------------------------------------------------------
+# batch proposals: one completion record per submission
+# ---------------------------------------------------------------------------
+
+# Entry.key namespace bit marking batch-tracked proposals: the key encodes
+# (batch_id, seq) instead of naming a per-request registry slot, so a
+# thousand-proposal batch costs ONE registration and ONE completion event
+# instead of a thousand (no referent in the reference — its clients are
+# strictly one RequestState per proposal, requests.go:267-329).
+BATCH_KEY_BIT = 1 << 62
+_BATCH_SEQ_BITS = 24
+
+
+def make_batch_id(node_id: int, counter: int) -> int:
+    """Batch ids are registry keys AND travel in replicated entry keys, so
+    they embed the submitting node's identity: a replica applying another
+    node's batch entries must not credit a same-numbered batch of its own
+    (the per-request path gets this protection from client_id/series_id
+    checks; the batch path gets it from the id itself)."""
+    return ((node_id & 0xFFFF) << 22) | (counter & 0x3FFFFF)
+
+
+def make_batch_key(batch_id: int, seq: int) -> int:
+    return BATCH_KEY_BIT | (batch_id << _BATCH_SEQ_BITS) | seq
+
+
+def batch_id_of(key: int) -> int:
+    return (key & ~BATCH_KEY_BIT) >> _BATCH_SEQ_BITS
+
+
+class BatchRequestState:
+    """Completion record for one propose_batch_async submission: counts
+    applied/dropped proposals and fires a single event when the whole
+    batch is accounted for. Thread-safe (engine loop + apply workers +
+    the waiting client)."""
+
+    __slots__ = ("batch_id", "n", "completed", "dropped", "deadline",
+                 "_event", "_mu")
+
+    def __init__(self, batch_id: int, n: int, deadline: int) -> None:
+        self.batch_id = batch_id
+        self.n = n
+        self.completed = 0
+        self.dropped = 0
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._mu = threading.Lock()
+
+    def add_done(self, completed: int = 0, dropped: int = 0) -> None:
+        with self._mu:
+            self.completed += completed
+            self.dropped += dropped
+            if self.completed + self.dropped >= self.n:
+                self._event.set()
+
+    def expire(self) -> None:
+        """Timeout: account every outstanding proposal as dropped."""
+        with self._mu:
+            rest = self.n - self.completed - self.dropped
+            if rest > 0:
+                self.dropped += rest
+            self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    @property
+    def finished(self) -> bool:
+        return self._event.is_set()
+
+
 # guards the callback handoff in RequestState._fire_cb; module-level so
 # the per-request fast path (no callback registered) stays lock-free
 _cb_fire_mu = threading.Lock()
@@ -205,9 +276,13 @@ class _ProposalShard:
         self._clock = clock
         # keys from this shard are ≡ offset (mod stride), so completions
         # route back by key alone; the random base has its low 16 bits
-        # clear, keeping the congruence intact
+        # clear, keeping the congruence intact. Bits 61+ stay clear so a
+        # per-request key can never collide with the BATCH_KEY_BIT
+        # namespace (batch-tracked proposals route by batch id instead).
         self._key_seq = itertools.count(
-            (int.from_bytes(os.urandom(6), "big") << 16) + offset, stride
+            ((int.from_bytes(os.urandom(6), "big") << 16)
+             & ((1 << 61) - 1)) + offset,
+            stride,
         )
         self.stopped = False
 
@@ -644,6 +719,10 @@ __all__ = [
     "REQUEST_DROPPED",
     "RequestResult",
     "RequestState",
+    "BatchRequestState",
+    "BATCH_KEY_BIT",
+    "make_batch_key",
+    "batch_id_of",
     "LogicalClock",
     "PendingProposal",
     "PendingReadIndex",

@@ -17,6 +17,7 @@ from typing import Callable, List, Optional, Protocol, Tuple
 import enum
 
 from ..config import Config
+from ..requests import BATCH_KEY_BIT
 from .encoded import decode_payload
 
 
@@ -187,6 +188,8 @@ class INodeProxy(Protocol):
         ignored: bool,
         notify_read: bool,
     ) -> None: ...
+
+    def apply_update_run(self, entries, results) -> None: ...
 
     def apply_config_change(self, cc: ConfigChange) -> None: ...
 
@@ -443,16 +446,69 @@ class StateMachineManager:
             return
         use_batch = self._sm.concurrent_snapshot() or self._sm.on_disk()
         apply.clear()
+        # fast path for EVERY SM type: maximal runs of plain no-op-session
+        # updates apply under ONE lock round-trip with ONE run-level
+        # completion notify (per-entry locks + notifications were the
+        # apply-side hot spot at high proposal rates). Log order is
+        # preserved by flushing the other buffer whenever the entry stream
+        # switches between the run and the session/config slow path.
+        run: List[Entry] = []
         for t in batch:
             for e in t.entries:
+                if (
+                    not e.is_config_change()
+                    and e.is_update()
+                    and not e.is_empty()
+                    and e.is_noop_session()
+                ):
+                    if apply:
+                        self._apply_batch(apply)
+                        apply.clear()
+                    run.append(e)
+                    continue
+                self._flush_run(run)
                 if use_batch:
                     self._handle_entry_batched(e, apply)
                 else:
                     self._handle_entry(e, False)
-        if use_batch and apply:
+        self._flush_run(run)
+        if apply:
             self._apply_batch(apply)
             apply.clear()
         batch.clear()
+
+    def _flush_run(self, run: List[Entry]) -> None:
+        """Apply a contiguous run of plain updates, then notify once."""
+        if not run:
+            return
+        ents = run[:]
+        run.clear()
+        skip_until = self._on_disk_init_index if self._sm.on_disk() else 0
+        smes = [SMEntry(index=e.index, cmd=decode_payload(e)) for e in ents]
+        to_run = [se for se in smes if se.index > skip_until]
+        done = self._sm.update(to_run) if to_run else []
+        # per-proposal results are only retained for per-request keys;
+        # batch-tracked proposals complete by count alone, so the common
+        # bulk path skips the result realignment entirely
+        if any(e.key and not (e.key & BATCH_KEY_BIT) for e in ents):
+            by_index = {se.index: se.result for se in done}
+            empty = Result()
+            results = [by_index.get(e.index, empty) for e in ents]
+        else:
+            results = None
+        last = ents[-1]
+        with self._mu:
+            self._set_applied(last.index, last.term)
+            if self._sm.on_disk():
+                self._on_disk_index = max(self._on_disk_index, last.index)
+        run_notify = getattr(self._node, "apply_update_run", None)
+        if run_notify is not None:
+            run_notify(ents, results)
+        else:  # minimal INodeProxy implementations (tests, tools)
+            if results is None:
+                results = [Result()] * len(ents)
+            for e, r in zip(ents, results):
+                self._node.apply_update(e, r, False, False, False)
 
     def _handle_entry_batched(self, e: Entry, apply: List[SMEntry]) -> None:
         """Batched path: plain updates accumulate; anything session- or

@@ -58,31 +58,47 @@ class _Shard:
     def _save_entries(self, wb: WriteBatch, cid: int, nid: int, ents) -> None:
         """Pack entries into batch records, merging the head batch with any
         retained prefix (a rewrite from mid-batch keeps the entries below
-        the rewrite point, cf. batch.go:60-126 merge rules)."""
+        the rewrite point, cf. batch.go:60-126 merge rules). The cache
+        keeps each entry's ENCODED bytes alongside it, so rewriting a batch
+        head re-joins cached parts instead of re-encoding every retained
+        entry (the encode was a measured save-path hot spot)."""
         B = self.BATCH
+        enc = codec.encode_entry
         first = ents[0].index
         bid = first // B
         cur: list = []
+        parts: list = []
         if first % B:
             with self._mu:
                 cached = self._batch_cache.get((cid, nid))
             if cached is not None and cached[0] == bid:
-                existing = cached[1]
+                existing, eparts = cached[1], cached[2]
             else:
                 raw = self.kv.get_value(keys.batch_key(cid, nid, bid))
                 existing = codec.decode_entries(raw)[0] if raw else []
-            cur = [e for e in existing if e.index < first]
+                eparts = None
+            keep = 0
+            for e in existing:  # ascending; retained prefix is e.index < first
+                if e.index >= first:
+                    break
+                keep += 1
+            cur = existing[:keep]
+            parts = (
+                eparts[:keep] if eparts is not None else [enc(e) for e in cur]
+            )
         for e in ents:
             b = e.index // B
             if b != bid:
                 wb.put(
-                    keys.batch_key(cid, nid, bid), codec.encode_entries(cur)
+                    keys.batch_key(cid, nid, bid),
+                    codec.join_encoded_entries(parts),
                 )
-                bid, cur = b, []
+                bid, cur, parts = b, [], []
             cur.append(e)
-        wb.put(keys.batch_key(cid, nid, bid), codec.encode_entries(cur))
+            parts.append(enc(e))
+        wb.put(keys.batch_key(cid, nid, bid), codec.join_encoded_entries(parts))
         with self._mu:
-            self._batch_cache[(cid, nid)] = (bid, list(cur))
+            self._batch_cache[(cid, nid)] = (bid, cur, parts)
 
     def _record_update(self, wb: WriteBatch, ud: Update) -> None:
         cid, nid = ud.cluster_id, ud.node_id
@@ -177,7 +193,7 @@ class _Shard:
                 with self._mu:
                     cached = self._batch_cache.get((cid, nid))
                     if cached is not None and cached[0] == cut_bid:
-                        self._batch_cache[(cid, nid)] = (cut_bid, keep)
+                        self._batch_cache[(cid, nid)] = (cut_bid, keep, None)
 
     def compact_entries_to(self, cid: int, nid: int, index: int) -> None:
         fk, lk = keys.batch_range(cid, nid, 0, (index + 1) // self.BATCH)

@@ -21,6 +21,7 @@ from bench import bench_e2e, _bench_sm_class  # noqa: E402
 def main() -> None:
     groups = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     duration = float(sys.argv[2]) if len(sys.argv) > 2 else 6.0
+    wave = int(sys.argv[3]) if len(sys.argv) > 3 else 128
     import bench as benchmod
     import dragonboat_tpu.nodehost as nodehost_mod
 
@@ -36,7 +37,7 @@ def main() -> None:
     nodehost_mod.NodeHost.stop = stop_with_profile
     workdir = tempfile.mkdtemp(prefix="dbtpu-prof-")
     try:
-        r = bench_e2e(groups, duration, 16, workdir)
+        r = bench_e2e(groups, duration, 16, workdir, wave=wave)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     print(json.dumps(r, indent=1))

@@ -110,3 +110,46 @@ def test_propose_batch_overflow_drops_tail(tmp_path):
         assert dropped + completed == n
     finally:
         nh.stop()
+
+
+@pytest.mark.parametrize("engine", ["scalar", "vector"])
+def test_propose_batch_async_handle(tmp_path, engine):
+    """propose_batch_async: ONE BatchRequestState for the whole batch,
+    completion counted in runs (batch keys route by (batch_id, seq))."""
+    reg = _Registry()
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=89, rtt_millisecond=5, raft_address="pba1:1",
+        nodehost_dir=str(tmp_path / "nh"),
+        raft_rpc_factory=lambda l: loopback_factory(l, reg),
+        engine=EngineConfig(kind=engine, max_groups=4, max_peers=4,
+                            log_window=64),
+    ))
+    try:
+        nh.start_cluster({1: "pba1:1"}, False, lambda c, n: CounterSM(),
+                         Config(cluster_id=1, node_id=1, election_rtt=20,
+                                heartbeat_rtt=2))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, ok = nh.get_leader_id(1)
+            if ok:
+                break
+            time.sleep(0.02)
+        assert ok
+        s = nh.get_noop_session(1)
+        h = nh.propose_batch_async(s, [b"y%d" % i for i in range(200)], 30.0)
+        assert h.wait(30.0)
+        assert h.completed == 200
+        assert h.dropped == 0
+        assert nh.stale_read(1, None) == 200
+        # a second batch reuses nothing from the first
+        h2 = nh.propose_batch_async(s, [b"z"] * 10, 30.0)
+        assert h2.wait(30.0)
+        assert h2.completed == 10
+        assert nh.stale_read(1, None) == 210
+        # registered sessions may not batch
+        sess = nh.sync_get_session(1, timeout_s=10.0)
+        with pytest.raises(ErrInvalidSession):
+            nh.propose_batch_async(sess, [b"a", b"b"], 5.0)
+        nh.sync_close_session(sess, timeout_s=10.0)
+    finally:
+        nh.stop()
