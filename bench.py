@@ -36,14 +36,15 @@ from dragonboat_tpu._jaxenv import maybe_pin_cpu, pin_cpu
 BASELINE_PROPOSALS_PER_SEC = 9_000_000  # reference README.md:46 (3-node peak)
 
 
-def _ensure_live_backend() -> str:
+def _ensure_live_backend(max_wait_s: float = 300.0) -> str:
     """Probe JAX backend init in a subprocess before touching it in-process.
 
     The environment's 'axon' TPU-tunnel backend can hang or fail during
     client creation; an in-process hang would wedge jax's backend lock for
-    good. Probe externally (backend init succeeds in seconds or hangs, so
-    a short timeout suffices; retry once), and fall back to a guarded CPU
-    backend if the accelerator is unreachable. Returns the platform name."""
+    good. Probe externally with escalating timeouts for up to ~max_wait_s
+    (a wedged tunnel often recovers within minutes — round 3 lost its TPU
+    number to a probe that gave up after 2x60s), then fall back to a
+    guarded CPU backend. Returns the platform name."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         maybe_pin_cpu()
         return "cpu"
@@ -51,11 +52,15 @@ def _ensure_live_backend() -> str:
         "import jax, sys; d = jax.devices(); "
         "sys.stdout.write(d[0].platform)"
     )
-    for _ in range(2):
+    t0 = time.monotonic()
+    attempt_timeout = 45.0
+    while time.monotonic() - t0 < max_wait_s:
+        budget = max_wait_s - (time.monotonic() - t0)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=60,
+                capture_output=True, text=True,
+                timeout=min(attempt_timeout, max(budget, 5.0)),
             )
             if r.returncode == 0 and r.stdout.strip():
                 platform = r.stdout.strip()
@@ -66,32 +71,52 @@ def _ensure_live_backend() -> str:
                 return platform
         except subprocess.TimeoutExpired:
             pass
+        attempt_timeout = min(attempt_timeout * 2, 120.0)
+        time.sleep(2.0)
     pin_cpu()
     return "cpu-fallback"
 
 
+# results accumulate here as each ladder config finishes, so the watchdog
+# can emit everything measured so far instead of an empty error record
+RECORD: dict = {
+    "metric": "e2e_proposals_per_sec",
+    "value": 0.0,
+    "unit": "proposals/s",
+    "vs_baseline": 0.0,
+}
+
+
 def _arm_watchdog(seconds: float, platform: str):
     """The probe can pass and the tunnel still wedge moments later at real
-    backend init. Guarantee the driver one parseable JSON line either way:
-    if the bench has not finished within the deadline, emit an error record
-    and hard-exit. Returns the timer (cancel on success)."""
+    backend init — and a CPU run can wedge on a deadlock just the same.
+    ALWAYS armed: guarantee the driver one parseable JSON line either way,
+    carrying whatever partial ladder results landed before the hang."""
     import threading
 
-    def fire() -> None:  # pragma: no cover - only on wedged backends
-        print(
-            json.dumps(
-                {
-                    "metric": "e2e_proposals_per_sec",
-                    "value": 0.0,
-                    "unit": "proposals/s",
-                    "vs_baseline": 0.0,
-                    "platform": platform,
-                    "error": f"watchdog: no result within {seconds:.0f}s",
-                }
-            ),
-            flush=True,
-        )
-        os._exit(3)
+    def fire() -> None:  # pragma: no cover - only on wedged runs
+        try:
+            snap = json.loads(json.dumps(RECORD, default=str))  # best-effort
+            snap["platform"] = platform
+            snap["error"] = f"watchdog: no result within {seconds:.0f}s"
+            print(json.dumps(snap), flush=True)
+        except Exception:
+            # RECORD mutated mid-dump: still emit SOMETHING parseable
+            print(
+                json.dumps(
+                    {
+                        "metric": "e2e_proposals_per_sec",
+                        "value": 0.0,
+                        "unit": "proposals/s",
+                        "vs_baseline": 0.0,
+                        "platform": platform,
+                        "error": f"watchdog: no result within {seconds:.0f}s",
+                    }
+                ),
+                flush=True,
+            )
+        finally:
+            os._exit(3)
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -169,28 +194,69 @@ def bench_e2e(
     inbox_depth: int = 4,
     entries_per_msg: int = 64,
     log_window: int = 256,
+    replicas: int = 3,
+    read_ratio: int = 0,
+    drop_rate: float = 0.0,
+    churn: bool = False,
 ):
-    """3 NodeHosts, G groups x 3 replicas, quorum + fsync + apply.
+    """N NodeHosts, G groups x N replicas, quorum + fsync + apply.
 
-    shared=True co-hosts all three NodeHosts on ONE engine core (the
-    TPU-native deployment shape: the whole replica fleet advances in one
-    kernel step; messages between replicas ride the shared inbox, not the
-    wire). shared=False keeps three independent engines talking over the
-    codec-encoded loopback transport."""
+    shared=True co-hosts all NodeHosts on ONE engine core (the TPU-native
+    deployment shape: the whole replica fleet advances in one kernel step;
+    messages between replicas ride the shared inbox, not the wire).
+    shared=False keeps independent engines talking over the codec-encoded
+    loopback transport.
+
+    read_ratio=R submits R linearizable ReadIndex requests per write
+    (BASELINE config 3's 9:1 mix). drop_rate randomly drops that fraction
+    of replication traffic (config 4's log-matching divergence stress).
+    churn interleaves snapshot requests and membership changes during the
+    measurement (config 5)."""
+    import random as _random
+
     from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
     from dragonboat_tpu.nodehost import NodeHost
     from dragonboat_tpu.statemachine import Result  # noqa: F401 (SM dep)
     from dragonboat_tpu.transport.loopback import loopback_factory, _Registry
+    from dragonboat_tpu.types import MessageType
 
     sm_cls = _bench_sm_class()
     reg = _Registry()
-    members = {1: "bench:1", 2: "bench:2", 3: "bench:3"}
+    members = {n: f"bench:{n}" for n in range(1, replicas + 1)}
     hosts = {}
+    try:
+        return _bench_e2e_body(
+            hosts, members, reg, sm_cls, groups, duration_s, payload,
+            workdir, shared, wave, inbox_depth, entries_per_msg, log_window,
+            replicas, read_ratio, drop_rate, churn,
+        )
+    finally:
+        # an exception must not leak NodeHosts: the share_scope='bench'
+        # core would survive (refcount never reaching zero) and poison
+        # every later ladder config with an engine-shape mismatch
+        for nh in hosts.values():
+            try:
+                nh.stop()
+            except Exception:
+                pass
+
+
+def _bench_e2e_body(
+    hosts, members, reg, sm_cls, groups, duration_s, payload, workdir,
+    shared, wave, inbox_depth, entries_per_msg, log_window, replicas,
+    read_ratio, drop_rate, churn,
+):
+    import random as _random
+
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.types import MessageType
+    from dragonboat_tpu.transport.loopback import loopback_factory
     # timers: the election timeout must comfortably exceed the in-process
-    # 3-engine message RTT AND the worst-case GIL starvation of an engine
-    # loop while the submitter thread bursts a wave, or heartbeat gaps
-    # trigger spurious elections mid-bench — the same config rule the
-    # reference documents for its RTT-derived timeouts (config.go:60-126).
+    # message RTT AND the worst-case GIL starvation of an engine loop
+    # while the submitter thread bursts a wave, or heartbeat gaps trigger
+    # spurious elections mid-bench — the same config rule the reference
+    # documents for its RTT-derived timeouts (config.go:60-126).
     # 10ms ticks x 100 election RTT = 1-2s timeouts, 200ms heartbeats.
     for nid, addr in members.items():
         cfg = NodeHostConfig(
@@ -200,8 +266,8 @@ def bench_e2e(
             raft_rpc_factory=lambda a: loopback_factory(a, reg),
             engine=EngineConfig(
                 kind="vector",
-                max_groups=3 * groups if shared else groups,
-                max_peers=4,
+                max_groups=replicas * groups if shared else groups,
+                max_peers=8 if replicas > 4 else 4,
                 log_window=log_window,
                 inbox_depth=inbox_depth,
                 max_entries_per_msg=entries_per_msg,
@@ -209,6 +275,20 @@ def bench_e2e(
             ),
         )
         hosts[nid] = NodeHost(cfg)
+    if drop_rate > 0 and shared:
+        # randomized replication drops over the co-hosted path (the wire
+        # analogue is the transport pre-send hook); rejects/backoff and
+        # re-replication must recover the divergence
+        rnd = _random.Random(1234)
+        rep_types = (
+            MessageType.REPLICATE,
+            MessageType.REPLICATE_RESP,
+        )
+
+        def _drop(m, _rnd=rnd, _t=rep_types):
+            return m.type in _t and _rnd.random() < drop_rate
+
+        hosts[1].engine.core.set_local_drop_hook(_drop)
     for c in range(1, groups + 1):
         for nid in members:
             hosts[nid].start_cluster(
@@ -246,8 +326,6 @@ def bench_e2e(
             time.sleep(0.05)
     bring_up_s = time.monotonic() - t0
     if pending:
-        for nh in hosts.values():
-            nh.stop()
         return {"error": f"{len(pending)} groups never elected", "value": 0.0}
     cmd = b"x" * payload
     sessions = {
@@ -262,8 +340,12 @@ def bench_e2e(
     WAVE = wave
     total = 0
     dropped = 0
+    reads_done = 0
+    reads_submitted = 0
     inflight: dict = {}
+    read_inflight: dict = {c: [] for c in sessions} if read_ratio else {}
     wave_cmds = [cmd] * WAVE
+    churn_state = {"snapshots": 0, "membership": 0, "next": 0.0, "rr": 0}
     t0 = time.perf_counter()
     deadline = t0 + duration_s
     next_leader_refresh = t0 + 0.5
@@ -276,11 +358,51 @@ def bench_e2e(
                     continue
                 total += h.completed
                 dropped += h.dropped
-            inflight[c] = hosts[leaders[c]].propose_batch_async(
-                sess, wave_cmds, 15
-            )
+                if read_ratio:
+                    rss = read_inflight[c]
+                    reads_done += sum(
+                        1
+                        for rs in rss
+                        if rs.result is not None and rs.result.completed
+                    )
+                    read_inflight[c] = []
+            nh = hosts[leaders[c]]
+            inflight[c] = nh.propose_batch_async(sess, wave_cmds, 15)
+            if read_ratio:
+                # R linearizable reads per write, riding the same cycle;
+                # PendingReadIndex batches them under shared system ctxs
+                n_reads = read_ratio * WAVE
+                rss = read_inflight[c]
+                for _ in range(n_reads):
+                    rss.append(nh.read_index(c, 15))
+                reads_submitted += n_reads
             progressed = True
         now = time.perf_counter()
+        if churn and now >= churn_state["next"]:
+            # BASELINE config 5: membership change + snapshot/compaction
+            # interleaved with the write load
+            churn_state["next"] = now + 0.5
+            rr = churn_state["rr"] = churn_state["rr"] % groups + 1
+            try:
+                hosts[leaders[rr]].request_snapshot(rr, timeout_s=30.0)
+                churn_state["snapshots"] += 1
+            except Exception:
+                pass
+            try:
+                # add-then-remove a (never-started) observer: the change
+                # itself commits through the log; replication to the absent
+                # node exercises the unreachable/breaker paths under load
+                cyc = churn_state["membership"] % 2
+                nh = hosts[leaders[rr]]
+                if cyc == 0:
+                    nh.request_add_observer(
+                        rr, replicas + 1, "bench:absent", timeout_s=5.0
+                    )
+                else:
+                    nh.request_delete_node(rr, replicas + 1, timeout_s=5.0)
+                churn_state["membership"] += 1
+            except Exception:
+                pass
         if now >= next_leader_refresh:
             next_leader_refresh = now + 0.5
             if snap_fn is not None:
@@ -300,13 +422,15 @@ def bench_e2e(
         h.wait(max(0.0, settle_deadline - time.perf_counter()))
         total += h.completed
         dropped += h.dropped
+    for c, rss in read_inflight.items():
+        for rs in rss:
+            if rs.result is not None and rs.result.completed:
+                reads_done += 1
     dt = time.perf_counter() - t0
-    for nh in hosts.values():
-        nh.stop()
-    return {
-        "value": total / dt,
+    out = {
+        "value": (total + reads_done) / dt,
         "groups": groups,
-        "replicas": 3,
+        "replicas": replicas,
         "payload_bytes": payload,
         "committed": total,
         "client_dropped": dropped,
@@ -316,6 +440,16 @@ def bench_e2e(
         "shared_engine": shared,
         "wave": wave,
     }
+    if read_ratio:
+        out["reads_completed"] = reads_done
+        out["reads_submitted"] = reads_submitted
+        out["read_ratio"] = read_ratio
+    if drop_rate:
+        out["drop_rate"] = drop_rate
+    if churn:
+        out["snapshots_requested"] = churn_state["snapshots"]
+        out["membership_changes"] = churn_state["membership"]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -368,69 +502,150 @@ def bench_kernel(groups: int, steps: int, warmup: int, log_window: int):
     return steps * G * K * E / dt
 
 
+# The BASELINE.json five-config ladder. `nominal` is the regime the
+# baseline names; `scaled` is what an e2e run at that regime costs on one
+# in-process box — group counts shrink so every config completes inside
+# the watchdog budget (the 50k-group regime is covered at full scale by
+# the kernel metric and the bring-up benchmark in tests/test_bring_up.py).
+LADDER = {
+    1: dict(
+        label="3-node, 1 group, 16B (benchmark_test.go baseline)",
+        nominal_groups=1, groups=1, replicas=3, payload=16, wave=512,
+        duration=6.0,
+    ),
+    2: dict(
+        label="3-node, 1024 groups, 16B, batched step",
+        nominal_groups=1024, groups=1024, replicas=3, payload=16,
+        wave=128, duration=10.0,
+    ),
+    3: dict(
+        label="5-node, 10k groups, 9:1 ReadIndex:write, elections on",
+        nominal_groups=10_000, groups=256, replicas=5, payload=16,
+        wave=8, duration=8.0, read_ratio=9,
+    ),
+    4: dict(
+        label="5-node, 50k groups, 128B, randomized follower drops",
+        nominal_groups=50_000, groups=256, replicas=5, payload=128,
+        wave=64, duration=8.0, drop_rate=0.01,
+    ),
+    5: dict(
+        label="5-node, 50k groups, membership + snapshot interleave",
+        nominal_groups=50_000, groups=128, replicas=5, payload=16,
+        wave=64, duration=8.0, churn=True,
+    ),
+}
+
+
+def _run_ladder_config(
+    n: int, spec: dict, cpu: bool, degraded: bool, explicit_groups: bool
+) -> dict:
+    groups = spec["groups"]
+    duration = spec["duration"]
+    if not explicit_groups:
+        if cpu and n >= 3:
+            # the 5-replica configs carry 5 lanes/group; keep the host
+            # half inside the watchdog budget on plain CPU boxes
+            groups = min(groups, 128)
+        if degraded:
+            # accelerator unreachable: shrink so the whole ladder still
+            # lands inside the watchdog budget on the fallback box
+            groups = min(groups, 256)
+            duration = min(duration, 6.0)
+    workdir = tempfile.mkdtemp(prefix=f"dbtpu-bench-c{n}-")
+    try:
+        r = bench_e2e(
+            groups, duration, spec["payload"], workdir,
+            wave=spec["wave"],
+            replicas=spec["replicas"],
+            read_ratio=spec.get("read_ratio", 0),
+            drop_rate=spec.get("drop_rate", 0.0),
+            churn=spec.get("churn", False),
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    r["label"] = spec["label"]
+    r["nominal_groups"] = spec["nominal_groups"]
+    if groups != spec["nominal_groups"]:
+        r["scaled_down"] = True
+    return r
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--groups", type=int, default=1024,
-                    help="e2e bench: 3-replica groups per NodeHost")
-    ap.add_argument("--duration", type=float, default=20.0)
-    ap.add_argument("--payload", type=int, default=16)
+    ap.add_argument("--config", type=int, default=0,
+                    choices=[0, 1, 2, 3, 4, 5],
+                    help="run ONE BASELINE.json ladder config (1-5) at its "
+                         "declared scale instead of the full reduced sweep")
+    ap.add_argument("--groups", type=int, default=0,
+                    help="override group count (with --config)")
+    ap.add_argument("--duration", type=float, default=0.0)
     ap.add_argument("--kernel-groups", type=int, default=50_000)
     ap.add_argument("--kernel-steps", type=int, default=50)
     ap.add_argument("--kernel-warmup", type=int, default=5)
     ap.add_argument("--kernel-log-window", type=int, default=512)
     ap.add_argument("--skip-kernel", action="store_true")
     ap.add_argument("--skip-e2e", action="store_true")
-    ap.add_argument("--watchdog-s", type=float, default=480.0)
+    ap.add_argument("--watchdog-s", type=float, default=560.0)
     args = ap.parse_args()
 
-    platform = _ensure_live_backend()
+    platform = _ensure_live_backend(
+        max_wait_s=60.0 if args.config else 300.0
+    )
+    cpu = platform in ("cpu", "cpu-fallback")
     if platform == "cpu-fallback":
-        # accelerator was unreachable: run a reduced CPU workload so the
-        # driver still records a parseable number instead of a timeout
-        args.groups = min(args.groups, 256)
-        args.duration = min(args.duration, 10.0)
-        args.kernel_groups = min(args.kernel_groups, 2048)
+        args.kernel_groups = min(args.kernel_groups, 4096)
         args.kernel_steps = min(args.kernel_steps, 10)
         args.kernel_log_window = min(args.kernel_log_window, 64)
 
-    # only the accelerator path can wedge post-probe (pinned cpu has no
-    # axon factory left); don't kill legitimately slow CPU runs
-    watchdog = _arm_watchdog(args.watchdog_s, platform) if platform not in (
-        "cpu", "cpu-fallback") else None
+    # ALWAYS armed — a CPU run can wedge on a deadlock just like the
+    # tunnel can post-probe; partial ladder results still get printed
+    watchdog = _arm_watchdog(args.watchdog_s, platform)
 
-    record = {
-        "metric": "e2e_proposals_per_sec",
-        "value": 0.0,
-        "unit": "proposals/s",
-        "vs_baseline": 0.0,
-        "platform": platform,
-    }
+    RECORD["platform"] = platform
+    if platform == "cpu-fallback":
+        RECORD["degraded"] = "accelerator unreachable; reduced CPU workload"
     if not args.skip_e2e:
-        workdir = tempfile.mkdtemp(prefix="dbtpu-bench-")
-        try:
-            e2e = bench_e2e(args.groups, args.duration, args.payload, workdir)
-        finally:
-            shutil.rmtree(workdir, ignore_errors=True)
-        record["value"] = round(e2e.pop("value", 0.0), 1)
-        record["vs_baseline"] = round(
-            record["value"] / BASELINE_PROPOSALS_PER_SEC, 6
+        configs = {}
+        RECORD["configs"] = configs
+        to_run = [args.config] if args.config else list(LADDER)
+        for n in to_run:
+            spec = dict(LADDER[n])
+            if args.config:
+                if args.groups:
+                    spec["groups"] = args.groups
+                else:
+                    spec["groups"] = spec["nominal_groups"]
+                if args.duration:
+                    spec["duration"] = args.duration
+            try:
+                configs[str(n)] = _run_ladder_config(
+                    n, spec, cpu,
+                    degraded=platform == "cpu-fallback",
+                    explicit_groups=bool(args.config and args.groups),
+                )
+            except Exception as e:  # record and keep laddering
+                configs[str(n)] = {"label": spec["label"], "error": repr(e)}
+        headline = configs.get(str(args.config or 2), {})
+        RECORD["value"] = round(headline.get("value", 0.0), 1)
+        RECORD["vs_baseline"] = round(
+            RECORD["value"] / BASELINE_PROPOSALS_PER_SEC, 6
         )
-        record["e2e"] = e2e
     if not args.skip_kernel:
         kv = bench_kernel(
             args.kernel_groups, args.kernel_steps, args.kernel_warmup,
             args.kernel_log_window,
         )
-        record["kernel_proposals_per_sec"] = round(kv, 1)
-        record["kernel_vs_baseline"] = round(kv / BASELINE_PROPOSALS_PER_SEC, 3)
+        RECORD["kernel_proposals_per_sec"] = round(kv, 1)
+        RECORD["kernel_vs_baseline"] = round(
+            kv / BASELINE_PROPOSALS_PER_SEC, 3
+        )
         if args.skip_e2e:
-            record["metric"] = "kernel_proposals_per_sec"
-            record["value"] = round(kv, 1)
-            record["vs_baseline"] = round(kv / BASELINE_PROPOSALS_PER_SEC, 3)
+            RECORD["metric"] = "kernel_proposals_per_sec"
+            RECORD["value"] = round(kv, 1)
+            RECORD["vs_baseline"] = round(kv / BASELINE_PROPOSALS_PER_SEC, 3)
 
-    if watchdog is not None:
-        watchdog.cancel()
-    print(json.dumps(record))
+    watchdog.cancel()
+    print(json.dumps(RECORD))
 
 
 if __name__ == "__main__":

@@ -582,6 +582,10 @@ class VectorEngine:
         self._host_refs: Set[int] = set()
         self._next_host = 0
         self._blocked_hosts: Set[int] = set()  # partitioned NodeHosts
+        # chaos hook over co-hosted delivery (the analogue of the
+        # transport's pre-send hook for traffic that never touches the
+        # wire): return True to drop the message
+        self._local_drop_hook = None
         # ---- host-event staging (producers: API/transport threads) -------
         self._dirty_mu = threading.Lock()
         self._dirty: Set[tuple] = set()  # lane keys with host events
@@ -763,6 +767,9 @@ class VectorEngine:
             # (nodehost.handle_message_batch returns early when
             # partitioned)
             return True
+        hook = self._local_drop_hook
+        if hook is not None and hook(m):
+            return True  # dropped by chaos hook
         node = lane.node
         if node.stopped or not node.mq.add(m):
             return False
@@ -774,6 +781,11 @@ class VectorEngine:
             self._blocked_hosts.add(host)
         else:
             self._blocked_hosts.discard(host)
+
+    def set_local_drop_hook(self, hook) -> None:
+        """Install a chaos drop predicate over co-hosted delivery
+        (hook(message) -> True drops it). None clears."""
+        self._local_drop_hook = hook
 
     # ------------------------------------------------- host->device bridges
     def membership_changed(self, node: VectorNode) -> None:
