@@ -41,11 +41,12 @@ def propose_retry(hs, cid, cmd, tries=4):
     # a proposal can be legitimately lost to election churn (appended at a
     # term that lost); real clients retry on timeout
     global lid
-    from dragonboat_tpu.requests import ErrTimeout
+    from dragonboat_tpu.requests import ErrTimeout, ErrClusterNotReady
     for _ in range(tries):
         try:
             return hs[lid].sync_propose(hs[lid].get_noop_session(cid), cmd, 10)
-        except ErrTimeout:
+        except (ErrTimeout, ErrClusterNotReady):
+            time.sleep(0.3)
             lid = wait_leader(hs, cid)
     raise SystemExit("propose kept timing out")
 r = propose_retry(hosts, 1, b"cmd")
@@ -75,13 +76,17 @@ for n in tm:
     th[n].start_cluster(dict(tm), False, lambda c,i: SM(c,i),
         Config(cluster_id=9, node_id=n, election_rtt=10, heartbeat_rtt=2))
 lid = wait_leader(th, 9)
-from dragonboat_tpu.requests import ErrTimeout
+from dragonboat_tpu.requests import ErrTimeout, ErrClusterNotReady
+r = None
 for _ in range(4):
     try:
         r = th[lid].sync_propose(th[lid].get_noop_session(9), b"x", 10)
         break
-    except ErrTimeout:
+    except (ErrTimeout, ErrClusterNotReady):
+        time.sleep(0.3)
         lid = wait_leader(th, 9)
+if r is None:
+    raise SystemExit("tcp propose kept failing (timeout/not-ready)")
 assert r.value >= 1
 print("tcp 2-host: OK")
 for nh in th.values(): nh.stop()

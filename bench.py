@@ -289,9 +289,9 @@ def _bench_e2e_body(
             return m.type in _t and _rnd.random() < drop_rate
 
         hosts[1].engine.core.set_local_drop_hook(_drop)
-    for c in range(1, groups + 1):
-        for nid in members:
-            hosts[nid].start_cluster(
+    for nid in members:
+        hosts[nid].start_clusters([
+            (
                 dict(members),
                 False,
                 lambda cid, nid_: sm_cls(cid, nid_),
@@ -300,6 +300,8 @@ def _bench_e2e_body(
                     heartbeat_rtt=20,
                 ),
             )
+            for c in range(1, groups + 1)
+        ])
     # wait for every group to elect a leader — ONE vectorized leadership
     # readout per poll instead of per-group get_leader_id calls
     t0 = time.monotonic()

@@ -80,10 +80,14 @@ class Snapshotter:
         self._dir = os.path.join(
             root_dir, f"snapshot-part-{cluster_id:020d}-{node_id:020d}"
         )
-        os.makedirs(self._dir, exist_ok=True)
         self._mu = threading.Lock()
         self._sm = None
-        self.process_orphans()
+        # lazy dir: a node that never snapshots never touches the fs — at
+        # 50k groups the per-cluster mkdir+orphan scan was a measured third
+        # of fleet bring-up. Orphan processing only matters if the dir
+        # already exists (a previous incarnation wrote into it).
+        if os.path.isdir(self._dir):
+            self.process_orphans()
 
     def bind_sm(self, sm) -> None:
         self._sm = sm

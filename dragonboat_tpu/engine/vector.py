@@ -93,19 +93,25 @@ MT = MessageType
 # device index value guard: rebase once any lane's last index crosses this
 _REBASE_THRESHOLD = 1 << 30
 
-# ctx encoding: (origin_slot + 1) << 24 | (ctx.low & 0xFFFFFF); the origin
-# slot rides inside the 31-bit device hint so a leader can route confirmed
-# forwarded reads back to the requesting replica (the reference keeps the
-# requester in the message envelope instead, raft.go:1871-1898)
+# ctx encoding over TWO int32 device planes: the low plane carries
+# (origin_slot + 1) << 24 | ctx.low[0:24], the high plane ctx.low[24:55].
+# 55 bits of the node's sequential read counter plus the origin slot are
+# collision-free for any realistic pending window (the reference carries a
+# 128-bit random SystemCtx in the message envelope, requests.go:365-381;
+# the origin slot rides inside the hint so a leader can route confirmed
+# forwarded reads back to the requesting replica, raft.go:1871-1898)
 _CTX_LOW_MASK = 0xFFFFFF
 
 
-def _enc_ctx(origin_slot: int, low: int) -> int:
-    return ((origin_slot + 1) << 24) | (low & _CTX_LOW_MASK)
+def _enc_ctx(origin_slot: int, low: int) -> tuple:
+    return (
+        ((origin_slot + 1) << 24) | (low & _CTX_LOW_MASK),
+        (low >> 24) & 0x7FFFFFFF,
+    )
 
 
-def _ctx_origin(enc: int) -> int:
-    return (enc >> 24) - 1
+def _ctx_origin(enc_lo: int) -> int:
+    return (enc_lo >> 24) - 1
 
 
 import functools
@@ -434,7 +440,7 @@ class _Lane:
         self.staged_ccs: deque = deque()  # (Entry, key)
         self.msg_backlog: deque = deque()  # wire Messages awaiting a slot
         self.pack_info: Dict[int, tuple] = {}
-        self.ri_pending: Dict[int, SystemCtx] = {}  # enc -> real ctx
+        self.ri_pending: Dict[Tuple[int, int], SystemCtx] = {}  # (lo,hi)->ctx
         self.recovering = False
         # term adopted from an InstallSnapshot sender; the restore ack must
         # carry it or the leader drops the ack as stale. Kept on the lane
@@ -641,6 +647,7 @@ class VectorEngine:
             "commit": np.zeros((G, K), np.int32),
             "reject": np.zeros((G, K), bool),
             "hint": np.zeros((G, K), np.int32),
+            "hint_high": np.zeros((G, K), np.int32),
             "n_entries": np.zeros((G, K), np.int32),
             "entry_terms": np.zeros((G, K, E), np.int32),
             "entry_cc": np.zeros((G, K, E), bool),
@@ -1079,7 +1086,8 @@ class VectorEngine:
                             lane.ri_pending[enc] = ctx
                             self._pack_row(
                                 g, k, MSG.READ_INDEX,
-                                from_slot=lane.self_slot(), hint=enc,
+                                from_slot=lane.self_slot(), hint=enc[0],
+                                hint_high=enc[1],
                             )
                             had = True
                             k += 1
@@ -1096,7 +1104,8 @@ class VectorEngine:
                                 cluster_id=node.cluster_id,
                                 to=leader_nid,
                                 from_=node.node_id(),
-                                hint=enc,
+                                hint=enc[0],
+                                hint_high=enc[1],
                             )
                         )
             # 5. leadership transfer
@@ -1119,7 +1128,8 @@ class VectorEngine:
     def _pack_row(
         self, g: int, k: int, mtype: int, from_slot: int = 0, term: int = 0,
         log_index: int = 0, log_term: int = 0, commit: int = 0,
-        reject: bool = False, hint: int = 0, n_entries: int = 0,
+        reject: bool = False, hint: int = 0, hint_high: int = 0,
+        n_entries: int = 0,
     ) -> None:
         buf = self._buf
         buf["mtype"][g, k] = mtype
@@ -1130,6 +1140,7 @@ class VectorEngine:
         buf["commit"][g, k] = commit
         buf["reject"][g, k] = reject
         buf["hint"][g, k] = hint
+        buf["hint_high"][g, k] = hint_high
         buf["n_entries"][g, k] = n_entries
 
     def _pack_wire(self, lane: _Lane, m: Message, k: int) -> bool:
@@ -1182,6 +1193,7 @@ class VectorEngine:
             self._pack_row(
                 g, k, MSG.HEARTBEAT, from_slot=from_slot, term=m.term,
                 commit=max(m.commit - b, 0), hint=m.hint,
+                hint_high=m.hint_high,
             )
             return True
         if t == MT.REQUEST_VOTE:
@@ -1214,19 +1226,20 @@ class VectorEngine:
         if t == MT.HEARTBEAT_RESP:
             self._pack_row(
                 g, k, MSG.HEARTBEAT_RESP, from_slot=from_slot, term=m.term,
-                hint=m.hint,
+                hint=m.hint, hint_high=m.hint_high,
             )
             return True
         if t == MT.READ_INDEX:
             self._pack_row(
                 g, k, MSG.READ_INDEX, from_slot=from_slot, term=m.term,
-                hint=m.hint,
+                hint=m.hint, hint_high=m.hint_high,
             )
             return True
         if t == MT.READ_INDEX_RESP:
             self._pack_row(
                 g, k, MSG.READ_INDEX_RESP, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, hint=m.hint,
+                hint_high=m.hint_high,
             )
             return True
         if t == MT.TIMEOUT_NOW:
@@ -1542,9 +1555,9 @@ class VectorEngine:
             n = int(o["ready_count"][g])
             node = lane.node
             for i in range(n):
-                enc = int(o["ready_ctx"][g, i])
+                enc = (int(o["ready_ctx"][g, i]), int(o["ready_ctx2"][g, i]))
                 idx = int(base[g]) + int(o["ready_index"][g, i])
-                origin = _ctx_origin(enc)
+                origin = _ctx_origin(enc[0])
                 if origin == lane.self_slot():
                     ctx = lane.ri_pending.pop(enc, None)
                     if ctx is not None:
@@ -1562,7 +1575,8 @@ class VectorEngine:
                                 from_=node.node_id(),
                                 term=int(self._m_term[g]),
                                 log_index=idx,
-                                hint=enc,
+                                hint=enc[0],
+                                hint_high=enc[1],
                             )
                         )
             node.pending_read_indexes.applied(node.sm.last_applied_index())
@@ -1593,6 +1607,7 @@ class VectorEngine:
             term=int(o["term"][g]),
             commit=int(self._m_base[g]) + int(o["send_hb_commit"][g, p]),
             hint=int(o["send_hint"][g, p]),
+            hint_high=int(o["send_hint2"][g, p]),
         )
 
     def _mk_timeout_now(self, lane, o, g, p, to_nid) -> Message:

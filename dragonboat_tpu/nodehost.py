@@ -226,9 +226,24 @@ class NodeHost(IMessageHandler):
         """cf. nodehost.go:431-475 StartCluster + startCluster:1476-1560.
         sm_factory(cluster_id, node_id) returns an IStateMachine /
         IConcurrentStateMachine / IOnDiskStateMachine."""
-        cfg.validate()
         if self._stopped.is_set():
             raise ErrClusterClosed()
+        bootstrap, new_node = self._prepare_cluster(
+            initial_members, join, sm_factory, cfg
+        )
+        if new_node:
+            self.logdb.save_bootstrap_info(
+                cfg.cluster_id, cfg.node_id, bootstrap
+            )
+        self._launch_node(
+            initial_members, join, sm_factory, cfg, bootstrap, new_node
+        )
+
+    def _prepare_cluster(self, initial_members, join, sm_factory, cfg: Config):
+        """Shared validation + SM-type probing + bootstrap construction for
+        both the single and bulk start paths (persisting is the caller's
+        job — the bulk path batches it)."""
+        cfg.validate()
         cluster_id, node_id = cfg.cluster_id, cfg.node_id
         with self._nodes_mu:
             if cluster_id in self._nodes:
@@ -239,9 +254,47 @@ class NodeHost(IMessageHandler):
         smtype = sm_type_of(probe)
         if hasattr(probe, "close"):
             probe.close()
-        bootstrap, new_node = self._bootstrap_cluster(
-            initial_members, join, cfg, smtype
-        )
+        return self._peek_bootstrap(initial_members, join, cfg, smtype)
+
+    def start_clusters(self, specs) -> None:
+        """Bulk StartCluster for fleet bring-up: specs are
+        (initial_members, join, sm_factory, config) tuples. Bootstrap
+        records for all new clusters persist in ONE fsynced batch per logdb
+        shard, and the engine activates all lanes in its batched scatter —
+        50k idle groups come up in seconds instead of minutes (the
+        reference brings groups up one StartCluster at a time,
+        nodehost.go:431-475; its cheap-idle-group story starts only after
+        launch, README.md:48-51)."""
+        if self._stopped.is_set():
+            raise ErrClusterClosed()
+        prepared = []
+        boots = []
+        seen: set = set()
+        for initial_members, join, sm_factory, cfg in specs:
+            if cfg.cluster_id in seen:
+                raise ErrClusterAlreadyExist()
+            seen.add(cfg.cluster_id)
+            bootstrap, new_node = self._prepare_cluster(
+                initial_members, join, sm_factory, cfg
+            )
+            if new_node:
+                boots.append((cfg.cluster_id, cfg.node_id, bootstrap))
+            prepared.append(
+                (initial_members, join, sm_factory, cfg, bootstrap, new_node)
+            )
+        # durability order preserved: every bootstrap record is on disk
+        # before any of these nodes writes raft state
+        if boots:
+            self.logdb.save_bootstrap_infos(boots)
+        for initial_members, join, sm_factory, cfg, bootstrap, new in prepared:
+            self._launch_node(
+                initial_members, join, sm_factory, cfg, bootstrap, new
+            )
+
+    def _launch_node(
+        self, initial_members, join, sm_factory, cfg, bootstrap, new_node
+    ) -> None:
+        cluster_id, node_id = cfg.cluster_id, cfg.node_id
         addresses = bootstrap.addresses if not join else {}
         peer_addresses = [
             PeerAddress(node_id=nid, address=addr)
@@ -286,6 +339,18 @@ class NodeHost(IMessageHandler):
         self, initial_members, join, cfg: Config, smtype: int
     ):
         """cf. nodehost.go:1445-1474 bootstrapCluster."""
+        bootstrap, new_node = self._peek_bootstrap(
+            initial_members, join, cfg, smtype
+        )
+        if new_node:
+            self.logdb.save_bootstrap_info(
+                cfg.cluster_id, cfg.node_id, bootstrap
+            )
+        return bootstrap, new_node
+
+    def _peek_bootstrap(self, initial_members, join, cfg: Config, smtype: int):
+        """Validate + build the bootstrap record WITHOUT persisting it (the
+        bulk path persists many records in one batch)."""
         cluster_id, node_id = cfg.cluster_id, cfg.node_id
         try:
             bootstrap = self.logdb.get_bootstrap_info(cluster_id, node_id)
@@ -299,7 +364,6 @@ class NodeHost(IMessageHandler):
             if not members:
                 raise ErrInvalidClusterSettings()
         bootstrap = Bootstrap(addresses=members, join=join, type=smtype)
-        self.logdb.save_bootstrap_info(cluster_id, node_id, bootstrap)
         return bootstrap, True
 
     def stop_cluster(self, cluster_id: int) -> None:

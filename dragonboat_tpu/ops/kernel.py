@@ -110,6 +110,7 @@ def _reset(s: RaftTensors, new_term, keep_term_vote=False) -> RaftTensors:
         transfer_to=jnp.zeros_like(s.transfer_to),
         pending_cc=jnp.zeros_like(s.pending_cc),
         ri_ctx=jnp.zeros_like(s.ri_ctx),
+        ri_ctx2=jnp.zeros_like(s.ri_ctx2),
         ri_index=jnp.zeros_like(s.ri_index),
         ri_acks=jnp.zeros_like(s.ri_acks),
         ri_count=jnp.zeros_like(s.ri_count),
@@ -526,7 +527,12 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )
     # readindex leadership confirmation (raft.go:1736-1756)
     R = s.ri_ctx.shape[1]
-    hint_match = hr[:, None] & (s.ri_ctx == m["hint"][:, None]) & (s.ri_ctx != 0)
+    hint_match = (
+        hr[:, None]
+        & (s.ri_ctx == m["hint"][:, None])
+        & (s.ri_ctx2 == m["hint_high"][:, None])
+        & (s.ri_ctx != 0)
+    )
     frombit = (jnp.int32(1) << from_slot)[:, None]
     s = s._replace(ri_acks=jnp.where(hint_match, s.ri_acks | frombit, s.ri_acks))
 
@@ -542,6 +548,7 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     posm = jax.nn.one_hot(pos, R, dtype=bool) & enq[:, None]
     s = s._replace(
         ri_ctx=jnp.where(posm, m["hint"][:, None], s.ri_ctx),
+        ri_ctx2=jnp.where(posm, m["hint_high"][:, None], s.ri_ctx2),
         ri_index=jnp.where(posm, s.committed[:, None], s.ri_index),
         ri_acks=jnp.where(posm, 0, s.ri_acks),
         ri_count=jnp.where(enq, s.ri_count + 1, s.ri_count),
@@ -554,11 +561,15 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     out["send_hint"] = jnp.where(
         enq[:, None] & others_v, m["hint"][:, None], out["send_hint"]
     )
+    out["send_hint2"] = jnp.where(
+        enq[:, None] & others_v, m["hint_high"][:, None], out["send_hint2"]
+    )
     # single-node: instantly ready (delivered via the ready queue at step end)
     imm = ok_ri & single
     posm2 = jax.nn.one_hot(s.ri_count, R, dtype=bool) & imm[:, None]
     s = s._replace(
         ri_ctx=jnp.where(posm2, m["hint"][:, None], s.ri_ctx),
+        ri_ctx2=jnp.where(posm2, m["hint_high"][:, None], s.ri_ctx2),
         ri_index=jnp.where(posm2, s.committed[:, None], s.ri_index),
         ri_acks=jnp.where(posm2, jnp.int32(-1), s.ri_acks),
         ri_count=jnp.where(imm, s.ri_count + 1, s.ri_count),
@@ -624,6 +635,7 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )[:, None]
     s = s._replace(
         ri_ctx=jnp.where(posm3, m["hint"][:, None], s.ri_ctx),
+        ri_ctx2=jnp.where(posm3, m["hint_high"][:, None], s.ri_ctx2),
         ri_index=jnp.where(posm3, m["log_index"][:, None], s.ri_index),
         ri_acks=jnp.where(posm3, jnp.int32(-1), s.ri_acks),
         ri_count=jnp.where(rir & (s.ri_count < R), s.ri_count + 1, s.ri_count),
@@ -756,8 +768,12 @@ def _tick(s: RaftTensors, ticks, out):
     R = s.ri_ctx.shape[1]
     newest_pos = jnp.maximum(s.ri_count - 1, 0)
     newest_ctx = jnp.take_along_axis(s.ri_ctx, newest_pos[:, None], axis=1)[:, 0]
+    newest_ctx2 = jnp.take_along_axis(
+        s.ri_ctx2, newest_pos[:, None], axis=1
+    )[:, 0]
     pending = s.ri_count > 0
     hint = jnp.where(pending, newest_ctx, 0)
+    hint2 = jnp.where(pending, newest_ctx2, 0)
     others_v = s.voting & ~_self_mask(s)
     obs = s.observer
     tgt = jnp.where(pending[:, None], others_v, others_v | obs)
@@ -765,6 +781,9 @@ def _tick(s: RaftTensors, ticks, out):
         hb_due[:, None] & tgt, out["send_flags"] | SEND_HEARTBEAT, out["send_flags"]
     )
     out["send_hint"] = jnp.where(hb_due[:, None] & tgt, hint[:, None], out["send_hint"])
+    out["send_hint2"] = jnp.where(
+        hb_due[:, None] & tgt, hint2[:, None], out["send_hint2"]
+    )
     return s, out
 
 
@@ -788,6 +807,7 @@ def step_batch(
     out = {
         "send_flags": jnp.zeros((G, P), i32),
         "send_hint": jnp.zeros((G, P), i32),
+        "send_hint2": jnp.zeros((G, P), i32),
         "noop_appended": jnp.zeros((G,), i32),
         "noop_term": jnp.zeros((G,), i32),
         "dropped_propose": jnp.zeros((G,), i32),
@@ -822,7 +842,6 @@ def step_batch(
         return (s, out), resps
 
     E = cfg.max_entries_per_msg
-    hint_high = jnp.zeros_like(inbox.hint)  # reserved (128-bit ctx upper half)
     slots = (
         jnp.moveaxis(inbox.mtype, 1, 0),
         jnp.moveaxis(inbox.from_slot, 1, 0),
@@ -832,7 +851,7 @@ def step_batch(
         jnp.moveaxis(inbox.commit, 1, 0),
         jnp.moveaxis(inbox.reject.astype(i32), 1, 0),
         jnp.moveaxis(inbox.hint, 1, 0),
-        jnp.moveaxis(hint_high, 1, 0),
+        jnp.moveaxis(inbox.hint_high, 1, 0),
         jnp.moveaxis(inbox.n_entries, 1, 0),
         jnp.moveaxis(inbox.entry_terms, 1, 0),
         jnp.moveaxis(inbox.entry_cc.astype(i32), 1, 0),
@@ -936,6 +955,7 @@ def step_batch(
     last_conf = jnp.max(jnp.where(confirmed, idxs + 1, 0), axis=1)  # count to pop
     popmask = idxs < last_conf[:, None]
     ready_ctx = jnp.where(popmask, s.ri_ctx, 0)
+    ready_ctx2 = jnp.where(popmask, s.ri_ctx2, 0)
     # released entries read at the confirming slot's index
     conf_idx = jnp.max(jnp.where(confirmed, s.ri_index, 0), axis=1)
     ready_index = jnp.where(popmask, jnp.minimum(s.ri_index, conf_idx[:, None]), 0)
@@ -949,6 +969,7 @@ def step_batch(
         return jnp.where(idxs < (s.ri_count - shift)[:, None], v, fill)
     s = s._replace(
         ri_ctx=shift_left(s.ri_ctx, 0),
+        ri_ctx2=shift_left(s.ri_ctx2, 0),
         ri_index=shift_left(s.ri_index, 0),
         ri_acks=shift_left(s.ri_acks, 0),
         ri_count=s.ri_count - shift,
@@ -999,6 +1020,7 @@ def step_batch(
         send_commit=send_commit,
         send_hb_commit=send_hb_commit,
         send_hint=out["send_hint"],
+        send_hint2=out["send_hint2"],
         vote_last_index=s.last_index,
         vote_last_term=last_term_out,
         resp_type=resps["resp_type"],
@@ -1015,6 +1037,7 @@ def step_batch(
         commit_index=s.committed,
         hard_changed=hard_changed & s.active,
         ready_ctx=ready_ctx,
+        ready_ctx2=ready_ctx2,
         ready_index=ready_index,
         ready_count=ready_count * s.active,
         dropped_propose=out["dropped_propose"],

@@ -46,6 +46,7 @@ class Msg:
     commit: int = 0
     reject: bool = False
     hint: int = 0
+    hint_high: int = 0
     n_entries: int = 0
     entry_terms: Tuple[int, ...] = ()
     entry_cc: Tuple[bool, ...] = ()
@@ -114,9 +115,9 @@ class LoopbackCluster:
             Msg(MSG.PROPOSE, from_slot=replica, n_entries=n, entry_cc=cc)
         )
 
-    def read_index(self, replica: int, group: int, ctx: int):
+    def read_index(self, replica: int, group: int, ctx: int, ctx_high: int = 0):
         self.pending[replica][group].append(
-            Msg(MSG.READ_INDEX, from_slot=replica, hint=ctx)
+            Msg(MSG.READ_INDEX, from_slot=replica, hint=ctx, hint_high=ctx_high)
         )
 
     def transfer_leader(self, replica: int, group: int, target_slot: int):
@@ -137,6 +138,7 @@ class LoopbackCluster:
             "commit": np.zeros((G, K), np.int32),
             "reject": np.zeros((G, K), bool),
             "hint": np.zeros((G, K), np.int32),
+            "hint_high": np.zeros((G, K), np.int32),
             "n_entries": np.zeros((G, K), np.int32),
         }
         eterms = np.zeros((G, K, E), np.int32)
@@ -154,6 +156,7 @@ class LoopbackCluster:
                 arr["commit"][g, k] = m.commit
                 arr["reject"][g, k] = m.reject
                 arr["hint"][g, k] = m.hint
+                arr["hint_high"][g, k] = m.hint_high
                 arr["n_entries"][g, k] = m.n_entries
                 for e, t in enumerate(m.entry_terms[:E]):
                     eterms[g, k, e] = t
@@ -168,6 +171,7 @@ class LoopbackCluster:
             commit=jnp.asarray(arr["commit"]),
             reject=jnp.asarray(arr["reject"]),
             hint=jnp.asarray(arr["hint"]),
+            hint_high=jnp.asarray(arr["hint_high"]),
             n_entries=jnp.asarray(arr["n_entries"]),
             entry_terms=jnp.asarray(eterms),
             entry_cc=jnp.asarray(ecc),
@@ -187,6 +191,7 @@ class LoopbackCluster:
         commit = np.asarray(out.send_commit)
         hb_commit = np.asarray(out.send_hb_commit)
         hint = np.asarray(out.send_hint)
+        hint2 = np.asarray(out.send_hint2)
         v_li = np.asarray(out.vote_last_index)
         v_lt = np.asarray(out.vote_last_term)
         rtype = np.asarray(out.resp_type)
@@ -195,12 +200,17 @@ class LoopbackCluster:
         rli = np.asarray(out.resp_log_index)
         rrej = np.asarray(out.resp_reject)
         rhint = np.asarray(out.resp_hint)
+        rhint2 = np.asarray(out.resp_hint2)
         ready_ctx = np.asarray(out.ready_ctx)
+        ready_ctx2 = np.asarray(out.ready_ctx2)
         ready_idx = np.asarray(out.ready_index)
         ready_n = np.asarray(out.ready_count)
         for g in range(self.n_groups):
             for n in range(int(ready_n[g])):
-                self.ready_reads[h].append((g, int(ready_ctx[g, n]), int(ready_idx[g, n])))
+                self.ready_reads[h].append(
+                    (g, int(ready_ctx[g, n]), int(ready_idx[g, n]),
+                     int(ready_ctx2[g, n]))
+                )
             for p in range(self.n_replicas):
                 if p == h:
                     continue
@@ -225,6 +235,7 @@ class LoopbackCluster:
                         Msg(
                             MSG.HEARTBEAT, from_slot=h, term=int(term[g]),
                             commit=int(hb_commit[g, p]), hint=int(hint[g, p]),
+                            hint_high=int(hint2[g, p]),
                         ),
                     )
                 if f & SEND_VOTE_REQ:
@@ -253,7 +264,7 @@ class LoopbackCluster:
                     Msg(
                         t, from_slot=h, term=int(rterm[g, k]),
                         log_index=int(rli[g, k]), reject=bool(rrej[g, k]),
-                        hint=int(rhint[g, k]),
+                        hint=int(rhint[g, k]), hint_high=int(rhint2[g, k]),
                     ),
                 )
 

@@ -140,8 +140,14 @@ class RaftTensors(NamedTuple):
     quiesce_threshold: jax.Array  # i32[G] idle ticks before entering
     quiesced: jax.Array  # bool[G]
     idle_ticks: jax.Array  # i32[G] ticks since last non-heartbeat activity
-    # read index queue (FIFO of R slots, ctx 0 = empty)
+    # read index queue (FIFO of R slots, ctx 0 = empty). The context is
+    # carried full-width in two planes: ri_ctx holds (origin_slot+1)<<24 |
+    # ctx.low[0:24], ri_ctx2 holds ctx.low[24:55] — 55 bits of the node's
+    # sequential read counter plus the origin slot, collision-free for any
+    # realistic pending window (the reference carries a 128-bit random
+    # SystemCtx in the message envelope instead, requests.go:365-381)
     ri_ctx: jax.Array  # i32[G,R]
+    ri_ctx2: jax.Array  # i32[G,R]
     ri_index: jax.Array  # i32[G,R]
     ri_acks: jax.Array  # i32[G,R] bitmask of peer slots that acked
     ri_count: jax.Array  # i32[G] live queue length
@@ -163,6 +169,7 @@ class Inbox(NamedTuple):
     commit: jax.Array  # i32[G,K]
     reject: jax.Array  # bool[G,K]
     hint: jax.Array  # i32[G,K]
+    hint_high: jax.Array  # i32[G,K] upper half of a readindex ctx
     n_entries: jax.Array  # i32[G,K]
     entry_terms: jax.Array  # i32[G,K,E]
     entry_cc: jax.Array  # bool[G,K,E]
@@ -182,6 +189,7 @@ class StepOutput(NamedTuple):
     # lagging follower never commits a divergent suffix (cf. raft.go:810-816)
     send_hb_commit: jax.Array  # i32[G,P]
     send_hint: jax.Array  # i32[G,P] readindex ctx (heartbeat) / transfer hint
+    send_hint2: jax.Array  # i32[G,P] upper ctx half for heartbeats
     vote_last_index: jax.Array  # i32[G] RequestVote: candidate last log index
     vote_last_term: jax.Array  # i32[G]
     # response plane: one reply per consumed inbox slot
@@ -200,6 +208,7 @@ class StepOutput(NamedTuple):
     commit_index: jax.Array  # i32[G] (for hard-state persistence)
     hard_changed: jax.Array  # bool[G] term/vote/commit changed this step
     ready_ctx: jax.Array  # i32[G,R] confirmed readindex contexts
+    ready_ctx2: jax.Array  # i32[G,R] upper ctx halves
     ready_index: jax.Array  # i32[G,R]
     ready_count: jax.Array  # i32[G]
     dropped_propose: jax.Array  # i32[G] proposals dropped (no leader etc.)
@@ -275,6 +284,7 @@ def init_state(cfg: KernelConfig) -> RaftTensors:
         quiesced=f_g(),
         idle_ticks=z_g(),
         ri_ctx=jnp.zeros((G, R), i32),
+        ri_ctx2=jnp.zeros((G, R), i32),
         ri_index=jnp.zeros((G, R), i32),
         ri_acks=jnp.zeros((G, R), i32),
         ri_count=z_g(),
@@ -294,6 +304,7 @@ def make_empty_inbox(cfg: KernelConfig) -> Inbox:
         commit=jnp.zeros((G, K), i32),
         reject=jnp.zeros((G, K), bool),
         hint=jnp.zeros((G, K), i32),
+        hint_high=jnp.zeros((G, K), i32),
         n_entries=jnp.zeros((G, K), i32),
         entry_terms=jnp.zeros((G, K, E), i32),
         entry_cc=jnp.zeros((G, K, E), bool),

@@ -61,6 +61,14 @@ class ILogDB(abc.ABC):
         self, cluster_id: int, node_id: int, bootstrap
     ) -> None: ...
 
+    def save_bootstrap_infos(self, items) -> None:
+        """Bulk bootstrap persistence for fleet bring-up; items are
+        (cluster_id, node_id, Bootstrap) tuples. Backends should override
+        with one atomic batch per shard — the default falls back to
+        per-item writes."""
+        for cid, nid, b in items:
+            self.save_bootstrap_info(cid, nid, b)
+
     @abc.abstractmethod
     def get_bootstrap_info(self, cluster_id: int, node_id: int): ...
 

@@ -254,6 +254,19 @@ class ShardedLogDB(ILogDB):
             codec.encode_bootstrap(bootstrap),
         )
 
+    def save_bootstrap_infos(self, items) -> None:
+        """One atomic fsynced write-batch per shard — fleet bring-up pays
+        one fsync per shard, not one per cluster (the per-cluster fsync
+        was 2/3 of the measured 50k-group start cost)."""
+        by_shard = {}
+        for cid, nid, b in items:
+            wb = by_shard.get(cid % self._num)
+            if wb is None:
+                wb = by_shard[cid % self._num] = WriteBatch()
+            wb.put(keys.bootstrap_key(cid, nid), codec.encode_bootstrap(b))
+        for sid, wb in by_shard.items():
+            self._shards[sid].kv.commit_write_batch(wb)
+
     def get_bootstrap_info(self, cluster_id, node_id):
         raw = self._shard(cluster_id).kv.get_value(
             keys.bootstrap_key(cluster_id, node_id)

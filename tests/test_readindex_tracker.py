@@ -93,3 +93,27 @@ def test_tracker_resets_on_state_change():
     net.elect(2)
     assert not rafts[1].is_leader()
     assert not rafts[1].read_index.has_pending_request()
+
+
+def test_full_width_ctx_no_collision_kernel():
+    """Two ReadIndex contexts identical in their LOW 24 bits must release
+    independently: the device carries the upper half in the ri_ctx2 plane
+    (cf. reference requests.go:365-381 full-width SystemCtx; round-3 carried
+    only 24 bits and collided under load)."""
+    from dragonboat_tpu.ops.loopback import LoopbackCluster
+
+    c = LoopbackCluster(n_replicas=3, n_groups=1)
+    c.run(30)
+    lead = c.leader_of(0)
+    c.propose(lead, 0, n=1)
+    c.run(6)
+    # same low plane value, different upper halves
+    c.read_index(lead, 0, ctx=0x123456, ctx_high=1)
+    c.run(6)
+    c.read_index(lead, 0, ctx=0x123456, ctx_high=2)
+    c.run(6)
+    got = [
+        (r[1], r[3]) for r in c.ready_reads[lead] if r[0] == 0
+    ]
+    assert (0x123456, 1) in got, got
+    assert (0x123456, 2) in got, got
