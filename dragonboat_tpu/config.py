@@ -131,6 +131,12 @@ class NodeHostConfig:
     system_event_listener: Optional[object] = None
     max_snapshot_send_bytes_per_second: int = 0
     max_snapshot_recv_bytes_per_second: int = 0
+    # outbound snapshot stream caps (cf. lane.go:40-237 + StreamConnections
+    # config.go:299-306): total concurrent lanes and per-target lanes; a
+    # request over either cap fails fast through the snapshot-status
+    # feedback path instead of queuing an unbounded thread
+    max_snapshot_connections: int = 8
+    max_snapshot_lanes_per_target: int = 2
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     def validate(self) -> None:

@@ -80,6 +80,24 @@ class ClusterInfo:
         self.is_leader = is_leader
 
 
+class NodeHostInfo:
+    """Aggregate introspection record (cf. nodehost.go:1289-1302
+    GetNodeHostInfo): the host's address, per-cluster states, and the logdb
+    inventory. Iterable over cluster_info for drop-in compatibility with
+    callers that treated get_nodehost_info() as a ClusterInfo list."""
+
+    def __init__(self, raft_address, cluster_info, log_info):
+        self.raft_address = raft_address
+        self.cluster_info = cluster_info
+        self.log_info = log_info
+
+    def __iter__(self):
+        return iter(self.cluster_info)
+
+    def __len__(self):
+        return len(self.cluster_info)
+
+
 class NodeHost(IMessageHandler):
     def __init__(self, cfg: NodeHostConfig) -> None:
         cfg.validate()
@@ -130,11 +148,29 @@ class NodeHost(IMessageHandler):
         from .transport.chunks import Chunks  # lazy: needs snapshot dir root
 
         self._chunks = Chunks(self)
-        self.transport.set_chunk_sink(self._chunks.add_chunk)
+        self.transport.set_chunk_sink(self._recv_chunk)
         self.transport.start()
-        self._snapshot_lanes = threading.Semaphore(
-            8
-        )  # cap concurrent outbound streams (cf. StreamConnections)
+        # outbound snapshot stream admission (cf. lane.go:40-237 +
+        # StreamConnections, config.go:299-306): hard caps on total and
+        # per-target concurrent lanes — a request over either cap fails
+        # fast via snapshot-status feedback, never queues a thread
+        from .transport.snapshotstream import RateLimiter
+
+        self._lane_mu = threading.Lock()
+        self._lanes_total = 0
+        self._lanes_by_target: Dict[str, int] = {}
+        self._max_lanes = max(1, cfg.max_snapshot_connections)
+        self._max_lanes_per_target = max(1, cfg.max_snapshot_lanes_per_target)
+        self._snap_send_rate = (
+            RateLimiter(cfg.max_snapshot_send_bytes_per_second)
+            if cfg.max_snapshot_send_bytes_per_second
+            else None
+        )
+        self._snap_recv_rate = (
+            RateLimiter(cfg.max_snapshot_recv_bytes_per_second)
+            if cfg.max_snapshot_recv_bytes_per_second
+            else None
+        )
         # --- engine
         if cfg.engine.kind == "vector":
             from .engine.vector import get_vector_engine
@@ -149,6 +185,9 @@ class NodeHost(IMessageHandler):
         )
         self._tick_thread.start()
         self._partitioned = False  # monkey-test knob
+        # ping/pong RTT samples: (cluster_id, peer) -> deque of microseconds
+        self._rtt_mu = threading.Lock()
+        self._rtt: Dict[tuple, object] = {}
 
     def _acquire_dir_lock(self) -> None:
         """Exclusive advisory lock on the nodehost dir (cf. reference
@@ -588,7 +627,8 @@ class NodeHost(IMessageHandler):
             return r.snapshot_index
         self._unwrap(r)
 
-    def get_nodehost_info(self) -> List[ClusterInfo]:
+    def get_nodehost_info(self, skip_log_info: bool = False) -> NodeHostInfo:
+        """cf. nodehost.go:1289-1302 GetNodeHostInfo."""
         out = []
         with self._nodes_mu:
             nodes = list(self._nodes.values())
@@ -604,7 +644,69 @@ class NodeHost(IMessageHandler):
                     is_leader=st["leader_id"] == n.node_id(),
                 )
             )
-        return out
+        log_info = [] if skip_log_info else self.logdb.list_node_info()
+        return NodeHostInfo(
+            raft_address=self.raft_address(),
+            cluster_info=out,
+            log_info=log_info,
+        )
+
+    # -------------------------------------------------------- RTT probing
+    def ping_peers(self, cluster_id: Optional[int] = None) -> int:
+        """Send Ping probes (cf. nodehost.go:2069-2088 sendPingMessage) to
+        every remote member of the given cluster (or all local clusters).
+        Pongs echo the monotonic timestamp; RTT samples land in
+        get_rtt_samples() and the transport_ping_rtt_us metric. Returns
+        the number of probes sent."""
+        if self._partitioned:
+            return 0  # probes are raft traffic too (monkey.go semantics)
+        with self._nodes_mu:
+            if cluster_id is not None:
+                node = self._nodes.get(cluster_id)
+                nodes = [node] if node is not None else []
+            else:
+                nodes = list(self._nodes.values())
+        sent = 0
+        now_us = time.monotonic_ns() // 1000
+        for n in nodes:
+            try:
+                members = n.sm.get_membership().addresses
+            except Exception:
+                continue
+            for nid in members:
+                if nid == n.node_id():
+                    continue
+                # deliberately NOT the co-hosted shortcut: the probe
+                # measures the WIRE path (a shared-core peer would answer
+                # from the inbox and report zero while the NIC is dead)
+                if self.transport.send(
+                    Message(
+                        type=MessageType.PING,
+                        cluster_id=n.cluster_id,
+                        to=nid,
+                        from_=n.node_id(),
+                        hint=now_us,
+                    )
+                ):
+                    sent += 1
+        return sent
+
+    def get_rtt_samples(self) -> Dict[tuple, List[int]]:
+        """(cluster_id, peer_node_id) -> recent RTT samples in microseconds."""
+        with self._rtt_mu:
+            return {k: list(v) for k, v in self._rtt.items()}
+
+    def _record_pong(self, m: Message) -> None:
+        rtt_us = max(0, time.monotonic_ns() // 1000 - m.hint)
+        key = (m.cluster_id, m.from_)
+        with self._rtt_mu:
+            dq = self._rtt.get(key)
+            if dq is None:
+                from collections import deque
+
+                dq = self._rtt[key] = deque(maxlen=16)
+            dq.append(rtt_us)
+        self.metrics.set_gauge("transport_ping_rtt_us", key, float(rtt_us))
 
     # ----------------------------------------------------- chaos-test knobs
     # cf. monkey.go:90-198 (build-tag-gated in the reference; here plain
@@ -651,30 +753,74 @@ class NodeHost(IMessageHandler):
             return
         self.transport.send(m)
 
+    def _recv_chunk(self, chunk) -> bool:
+        """Inbound chunk sink with the receive-side bandwidth cap: the
+        throttle sleeps the transport's delivery thread, back-pressuring
+        the sender's stream naturally."""
+        if self._snap_recv_rate is not None:
+            self._snap_recv_rate.acquire(getattr(chunk, "chunk_size", 0))
+        return self._chunks.add_chunk(chunk)
+
+    def _try_admit_lane(self, addr: str) -> bool:
+        with self._lane_mu:
+            per = self._lanes_by_target.get(addr, 0)
+            if (
+                self._lanes_total >= self._max_lanes
+                or per >= self._max_lanes_per_target
+            ):
+                return False
+            self._lanes_total += 1
+            self._lanes_by_target[addr] = per + 1
+        return True
+
+    def _release_lane(self, addr: str) -> None:
+        with self._lane_mu:
+            self._lanes_total = max(0, self._lanes_total - 1)
+            per = self._lanes_by_target.get(addr, 1) - 1
+            if per <= 0:
+                self._lanes_by_target.pop(addr, None)
+            else:
+                self._lanes_by_target[addr] = per
+
     def _async_send_snapshot(self, m: Message) -> None:
         """Stream a snapshot to a lagging peer on a dedicated lane
-        (cf. nodehost.go:1724-1744 + transport snapshot.go:55-110)."""
+        (cf. nodehost.go:1724-1744 + transport snapshot.go:55-110), subject
+        to the total and per-target lane caps."""
         from .transport.snapshotstream import SnapshotLane
 
         addr = self.transport.nodes.resolve(m.cluster_id, m.to)
         if addr is None:
             self._report_snapshot_status(m.cluster_id, m.to, True)
             return
+        if not self._try_admit_lane(addr):
+            # over the cap: fail fast through the status-feedback path (the
+            # raft core retries after its snapshot-status window) instead
+            # of parking an unbounded thread on a slow sink
+            self._report_snapshot_status(m.cluster_id, m.to, True)
+            return
         try:
-            ss_state = self._get_node(m.cluster_id).ss
-            ss_state.begin_stream()
+            try:
+                ss_state = self._get_node(m.cluster_id).ss
+                ss_state.begin_stream()
+            except Exception:
+                ss_state = None
+
+            def on_done(cluster_id: int, to: int, failed: bool) -> None:
+                if ss_state is not None:
+                    ss_state.end_stream()
+                self._report_snapshot_status(cluster_id, to, failed)
+
+            lane = SnapshotLane(
+                self.transport, addr, m, on_done,
+                release=lambda: self._release_lane(addr),
+                rate_limiter=self._snap_send_rate,
+            )
+            lane.start()
         except Exception:
-            ss_state = None
-
-        def on_done(cluster_id: int, to: int, failed: bool) -> None:
-            if ss_state is not None:
-                ss_state.end_stream()
-            self._report_snapshot_status(cluster_id, to, failed)
-
-        lane = SnapshotLane(
-            self.transport, addr, m, on_done, max_concurrent=self._snapshot_lanes
-        )
-        lane.start()
+            # thread exhaustion etc.: the admitted slot must not leak —
+            # a few leaks would permanently fail-fast this target
+            self._release_lane(addr)
+            self._report_snapshot_status(m.cluster_id, m.to, True)
 
     def _report_snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
         # status lands in the sender's own raft (remote leaves Snapshot state)
@@ -688,6 +834,22 @@ class NodeHost(IMessageHandler):
         for m in batch.requests:
             if m.type == MessageType.SNAPSHOT_RECEIVED:
                 self._on_snapshot_received(m)
+                continue
+            if m.type == MessageType.PING:
+                # transport-level RTT probe: echo without raft involvement
+                # (cf. nodehost.go:1759-1773 handlePingMessage)
+                self.transport.send(
+                    Message(
+                        type=MessageType.PONG,
+                        cluster_id=m.cluster_id,
+                        to=m.from_,
+                        from_=m.to,
+                        hint=m.hint,
+                    )
+                )
+                continue
+            if m.type == MessageType.PONG:
+                self._record_pong(m)
                 continue
             with self._nodes_mu:
                 node = self._nodes.get(m.cluster_id)

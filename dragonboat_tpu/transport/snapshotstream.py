@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, List, Optional
 
 from ..settings import soft
@@ -83,9 +84,41 @@ def load_chunk_data(chunk: SnapshotChunk, chunk_size: int = 0) -> SnapshotChunk:
     return chunk
 
 
+class RateLimiter:
+    """Token-bucket byte throttle for snapshot streams (cf. the reference's
+    SnapshotBytesPerSecond knobs, config.go:299-306). acquire(n) sleeps the
+    calling thread until n bytes of budget exist; rate 0 = unlimited."""
+
+    def __init__(self, bytes_per_second: int, burst: Optional[int] = None):
+        self.rate = bytes_per_second
+        self._burst = burst or max(bytes_per_second, 1)
+        self._tokens = float(self._burst)
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def acquire(self, n: int) -> None:
+        if self.rate <= 0 or n <= 0:
+            return
+        # debt model: take the bytes immediately and sleep off any deficit,
+        # so an acquisition larger than the burst cannot spin forever
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(
+                self._burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            self._tokens -= n
+            wait = -self._tokens / self.rate if self._tokens < 0 else 0.0
+        if wait > 0:
+            time.sleep(wait)
+
+
 class SnapshotLane:
     """One in-flight outbound snapshot stream (cf. lane.go:40-237); runs on
-    its own thread, reports success/failure back to the leader's raft."""
+    its own thread, reports success/failure back to the leader's raft.
+    Admission (total + per-target lane caps) is the caller's job — a lane
+    that starts always owns a slot; release() runs exactly once when the
+    stream ends."""
 
     def __init__(
         self,
@@ -93,13 +126,15 @@ class SnapshotLane:
         target_addr: str,
         m: Message,
         on_done: Callable[[int, int, bool], None],
-        max_concurrent: Optional[threading.Semaphore] = None,
+        release: Optional[Callable[[], None]] = None,
+        rate_limiter: Optional[RateLimiter] = None,
     ) -> None:
         self._transport = transport
         self._target = target_addr
         self._m = m
         self._on_done = on_done
-        self._sem = max_concurrent
+        self._release = release
+        self._rate = rate_limiter
         self.thread = threading.Thread(
             target=self._run, name="snapshot-lane", daemon=True
         )
@@ -108,9 +143,6 @@ class SnapshotLane:
         self.thread.start()
 
     def _run(self) -> None:
-        if self._sem is not None and not self._sem.acquire(timeout=60):
-            self._on_done(self._m.cluster_id, self._m.to, True)
-            return
         failed = False
         conn = None
         try:
@@ -118,6 +150,8 @@ class SnapshotLane:
             for chunk in split_snapshot_message(self._m):
                 if not self._m.snapshot.witness:
                     chunk = load_chunk_data(chunk)
+                if self._rate is not None:
+                    self._rate.acquire(chunk.chunk_size)
                 conn.send_chunk(chunk)
         except Exception:
             failed = True
@@ -127,12 +161,17 @@ class SnapshotLane:
                     conn.close()
                 except Exception:
                     pass
-            if self._sem is not None:
-                self._sem.release()
+            if self._release is not None:
+                self._release()
             # failure feeds SnapshotStatus back into the sender's raft;
             # success waits for the receiver's SnapshotReceived ack
             if failed:
                 self._on_done(self._m.cluster_id, self._m.to, True)
 
 
-__all__ = ["split_snapshot_message", "load_chunk_data", "SnapshotLane"]
+__all__ = [
+    "split_snapshot_message",
+    "load_chunk_data",
+    "RateLimiter",
+    "SnapshotLane",
+]

@@ -286,3 +286,57 @@ def test_leader_transfer(engine_kind):
     finally:
         for nh in nhs.values():
             nh.stop()
+
+
+def test_ping_pong_rtt_and_nodehost_info(tmp_path):
+    """RTT probing (cf. nodehost.go:2069-2088) + aggregate introspection
+    (cf. nodehost.go:1289-1302 GetNodeHostInfo with log info)."""
+    import time as _t
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+    reg = _Registry()
+    members = {1: "rtt:1", 2: "rtt:2", 3: "rtt:3"}
+    hosts = {}
+    for nid, addr in members.items():
+        hosts[nid] = NodeHost(NodeHostConfig(
+            deployment_id=77, rtt_millisecond=5, raft_address=addr,
+            nodehost_dir=str(tmp_path / f"nh{nid}"),
+            raft_rpc_factory=lambda l, r=reg: loopback_factory(l, r),
+            engine=EngineConfig(kind="vector", max_groups=4, max_peers=4,
+                                log_window=64),
+        ))
+    try:
+        for nid in members:
+            hosts[nid].start_cluster(
+                dict(members), False, lambda c, n: KVSM(c, n),
+                Config(cluster_id=1, node_id=nid, election_rtt=20,
+                       heartbeat_rtt=2))
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            if any(hosts[n].get_leader_id(1)[1] for n in members):
+                break
+            _t.sleep(0.02)
+        sent = hosts[1].ping_peers()
+        assert sent == 2
+        deadline = _t.time() + 10
+        while _t.time() < deadline and len(hosts[1].get_rtt_samples()) < 2:
+            _t.sleep(0.05)
+        samples = hosts[1].get_rtt_samples()
+        assert set(samples) == {(1, 2), (1, 3)}, samples
+        for vals in samples.values():
+            assert len(vals) >= 1
+            assert 0 <= vals[0] < 10_000_000  # microseconds, sane bound
+        # aggregate info: cluster list + logdb inventory, iterable for
+        # backwards compatibility
+        info = hosts[1].get_nodehost_info()
+        assert info.raft_address == "rtt:1"
+        cis = list(info)
+        assert len(cis) == 1 and cis[0].cluster_id == 1
+        assert any(
+            ni.cluster_id == 1 and ni.node_id == 1 for ni in info.log_info
+        )
+        lean = hosts[1].get_nodehost_info(skip_log_info=True)
+        assert lean.log_info == []
+    finally:
+        for nh in hosts.values():
+            nh.stop()
