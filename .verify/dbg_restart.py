@@ -1,0 +1,134 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from dragonboat_tpu._jaxenv import maybe_pin_cpu
+maybe_pin_cpu()
+import tempfile, shutil, json, zlib
+import numpy as np
+import dragonboat_tpu.engine.vector as _vec
+from dragonboat_tpu.ops.kernel import make_step_fn as _orig_msf
+_vec.make_step_fn = lambda cfg, donate=True: _orig_msf(cfg, False)
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.loopback import loopback_factory, _Registry
+
+G = 64
+class KV(IStateMachine):
+    def __init__(s): s.d = {}
+    def update(s, data):
+        k, v = data.decode().split("=", 1); s.d[k] = v; return Result(value=1)
+    def lookup(s, q): return s.d.get(q)
+    def get_hash(s): return zlib.crc32(json.dumps(sorted(s.d.items())).encode())
+    def save_snapshot(s, w, files, done): w.write(json.dumps(s.d).encode())
+    def recover_from_snapshot(s, r, files, done): s.d = json.loads(r.read().decode())
+
+reg = _Registry()
+wd = tempfile.mkdtemp(prefix="dbtpu-rs-")
+def mk(nid):
+    nh = NodeHost(NodeHostConfig(
+        deployment_id=4, rtt_millisecond=10, nodehost_dir=f"{wd}/h{nid}",
+        raft_address=f"rs{nid}:1",
+        raft_rpc_factory=lambda l: loopback_factory(l, reg),
+        engine=EngineConfig(kind="vector", max_groups=3*G, max_peers=4,
+            log_window=128, inbox_depth=4, max_entries_per_msg=16,
+            share_scope="rs")))
+    members = {h: f"rs{h}:1" for h in (1,2,3)}
+    nh.start_clusters([
+        (dict(members), False, lambda c, n: KV(),
+         Config(cluster_id=c, node_id=nid, election_rtt=60, heartbeat_rtt=10))
+        for c in range(1, G+1)])
+    return nh
+hosts = {n: mk(n) for n in (1,2,3)}
+t0 = time.monotonic()
+while time.monotonic()-t0 < 60:
+    snap = hosts[1].engine.leader_snapshot()
+    if sum(1 for c,(l,_t) in snap.items() if l) == G: break
+    time.sleep(0.05)
+leaders = {c:l for c,(l,_t) in hosts[1].engine.leader_snapshot().items() if l}
+print("elected", len(leaders), flush=True)
+# load
+for c in range(1, G+1):
+    nh = hosts[leaders[c]]
+    h = nh.propose_batch_async(nh.get_noop_session(c), [b"a=%d" % i for i in range(100)], 15)
+    h.wait(15)
+print("preload done", flush=True)
+# restart host 2 while loading more
+import threading
+stop = threading.Event()
+def load():
+    while not stop.is_set():
+        nh0 = next((h for h in hosts.values() if h is not None), None)
+        if nh0 is None:
+            time.sleep(0.05); continue
+        lm = {c:l for c,(l,_t) in nh0.engine.leader_snapshot().items() if l}
+        for c in range(1, G+1):
+            nh = hosts.get(lm.get(c))
+            if nh is None: continue
+            try:
+                nh.propose_batch_async(nh.get_noop_session(c), [b"b=1"]*8, 5)
+            except Exception: pass
+        time.sleep(0.05)
+t = threading.Thread(target=load, daemon=True); t.start()
+import random
+rng = random.Random(7)
+from dragonboat_tpu.types import MessageType
+core = hosts[1].engine.core
+t_end = time.monotonic() + 35
+while time.monotonic() < t_end:
+    fault = rng.choice(["partition", "drop", "restart", "none"])
+    victim = rng.choice((1,2,3))
+    nh = hosts.get(victim)
+    if nh is None: continue
+    if fault == "partition":
+        nh.set_partitioned(True); time.sleep(rng.uniform(0.4, 1.0))
+        if hosts.get(victim) is not None: hosts[victim].set_partitioned(False)
+    elif fault == "drop":
+        dr = random.Random(rng.random())
+        rep = (MessageType.REPLICATE, MessageType.REPLICATE_RESP)
+        core.set_local_drop_hook(lambda m: m.type in rep and dr.random() < 0.25)
+        time.sleep(rng.uniform(0.4, 1.0))
+        core.set_local_drop_hook(None)
+    elif fault == "restart":
+        hosts[victim] = None; nh.stop(); time.sleep(rng.uniform(0.2, 0.5))
+        hosts[victim] = mk(victim)
+    else:
+        time.sleep(0.4)
+stop.set(); t.join()
+core.set_local_drop_hook(None)
+for n in (1,2,3): hosts[n].set_partitioned(False)
+# converge check
+deadline = time.monotonic() + 30
+bad = {}
+while time.monotonic() < deadline:
+    bad = {}
+    for c in range(1, G+1):
+        idx = {n: hosts[n].get_applied_index(c) for n in (1,2,3)}
+        if len(set(idx.values())) != 1: bad[c] = idx
+    if not bad: break
+    time.sleep(0.2)
+print("diverged:", bad, flush=True)
+badh = {}
+for c in range(1, G+1):
+    hs = {n: hosts[n].get_sm_hash(c) for n in (1,2,3)}
+    if len(set(hs.values())) != 1: badh[c] = hs
+print("hash diverged:", badh, flush=True)
+if bad:
+    core = hosts[1].engine.core
+    st = core._state
+    for c in list(bad)[:2]:
+        for nid in (1,2,3):
+            lane = core._route.get((c, nid))
+            if lane is None: print(" no lane", c, nid); continue
+            g = lane.g
+            fr = lane.node.log_reader.get_range()
+            print(f" c={c} n={nid} g={g} role={int(core._m_role[g])} term={int(core._m_term[g])} "
+                  f"base={int(core._m_base[g])} last={int(np.asarray(st.last_index[g]))} "
+                  f"commit={int(np.asarray(st.committed[g]))} first={int(np.asarray(st.first_index[g]))} "
+                  f"match={np.asarray(st.match[g]).tolist()} next={np.asarray(st.next[g]).tolist()} "
+                  f"rstate={np.asarray(st.rstate[g]).tolist()} snap_sent={np.asarray(st.snap_sent[g]).tolist()} "
+                  f"logrange={fr} applied={lane.node.sm.last_applied_index()} "
+                  f"catchup={lane.catchup} snapinfl={lane.snap_inflight}", flush=True)
+for nh in hosts.values():
+    if nh is not None: nh.stop()
+shutil.rmtree(wd, ignore_errors=True)

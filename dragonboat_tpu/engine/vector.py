@@ -1306,6 +1306,7 @@ class VectorEngine:
 
     # --------------------------------------------------------------- decode
     def _decode(self, worked: Set[_Lane], o: dict) -> None:
+        self.last_output = o  # numpy snapshot for diagnostics/tools
         prof = self.profiler
         prof.start()
         lane_by_g = self._lane_by_g
@@ -1409,11 +1410,13 @@ class VectorEngine:
             try:
                 ents = [lane.arena[b + prev + 1 + i] for i in range(n)]
             except KeyError:
-                _plog.errorf(
-                    "%s missing arena entries for replicate [%d..%d]",
-                    lane.node.describe(), b + prev + 1, b + prev + n,
-                )
-                continue
+                ents = self._fetch_from_log(lane, b + prev + 1, b + prev + n)
+                if ents is None:
+                    _plog.errorf(
+                        "%s missing entries for replicate [%d..%d]",
+                        lane.node.describe(), b + prev + 1, b + prev + n,
+                    )
+                    continue
             lane.node._send_message(
                 Message(
                     type=MT.REPLICATE,
@@ -1526,11 +1529,16 @@ class VectorEngine:
             af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
             ents, missing_at = lane.arena.get_run(b + af, b + at)
             if ents is None:
-                _plog.errorf(
-                    "%s missing arena entry %d for apply",
-                    lane.node.describe(), missing_at,
-                )
-                continue
+                # the ring only spans the device window; a restart replays
+                # the WHOLE committed log through the SM, whose early
+                # entries live in the host log alone
+                ents = self._fetch_from_log(lane, b + af, b + at)
+                if ents is None:
+                    _plog.errorf(
+                        "%s missing entry %d for apply (arena+log)",
+                        lane.node.describe(), missing_at,
+                    )
+                    continue
             if not ents:
                 continue
             lane.node.sm.task_queue.add(
@@ -1585,6 +1593,20 @@ class VectorEngine:
         prof.start()
         self._maintain(o)
         prof.end("maintain")
+
+    def _fetch_from_log(self, lane: _Lane, lo: int, hi: int):
+        """Contiguous [lo, hi] from the host log (the arena ring's backing
+        tier); None if the log cannot serve the whole range."""
+        try:
+            ents = lane.node.log_reader.entries(lo, hi + 1, 1 << 30)
+        except Exception:
+            return None
+        if (
+            len(ents) != hi - lo + 1
+            or (ents and (ents[0].index != lo or ents[-1].index != hi))
+        ):
+            return None
+        return ents
 
     def _mk_vote(self, lane, o, g, p, to_nid) -> Message:
         return Message(
@@ -1864,6 +1886,27 @@ class VectorEngine:
             self._run_catchups(lane, o)
         for lane in list(self._snapfb):
             self._run_snapshot_feedback(lane, o)
+        # parked-peer watchdog: a remote in SNAPSHOT state whose host-side
+        # recovery (catchup or snapshot feedback) is no longer tracked is
+        # permanently wedged — the kernel only reports NEED_SNAPSHOT for
+        # UNpaused peers, so nothing would ever re-arm it. Leadership races
+        # (a catchup exiting on a stale goal, a feedback entry fast-acked
+        # against an older snapshot watermark) can drop the tracker; this
+        # sweep re-enters the recovery path. (cf. the reference's
+        # unconditional snapshot-status feedback loop, feedback.go:38-128)
+        parked = (o["rstate"] == RSTATE.SNAPSHOT) & (
+            (o["role"] == ROLE.LEADER)[:, None]
+        )
+        for g, p in zip(*np.nonzero(parked)):
+            lane = lane_by_g[g]
+            if (
+                lane is None
+                or not lane.active
+                or p in lane.catchup
+                or p in lane.snap_inflight
+            ):
+                continue
+            self._start_catchup(lane, int(p), o)
         # periodic snapshot by applied-entry count (node.go:585-601); a
         # wedged window forces one regardless of config. Candidates are
         # found vectorized; only triggering lanes cost Python.

@@ -1053,6 +1053,7 @@ def step_batch(
         vote=s.vote,
         role=s.role,
         match=s.match,
+        rstate=s.rstate,
         last_index=s.last_index,
         quiesced=s.quiesced,
     )

@@ -228,6 +228,8 @@ class StepOutput(NamedTuple):
     vote: jax.Array  # i32[G] slot+1, 0=none (for hard-state persistence)
     role: jax.Array  # i32[G] ROLE.*
     match: jax.Array  # i32[G,P]
+    rstate: jax.Array  # i32[G,P] flow-control state (host watchdog re-arms
+    #   parked peers whose recovery tracker was lost to a leadership race)
     last_index: jax.Array  # i32[G]
     quiesced: jax.Array  # bool[G] lane idle-frozen (host packs a wake NOOP
     #   before staging work for a quiesced lane)

@@ -455,6 +455,11 @@ class StateMachineManager:
         run: List[Entry] = []
         for t in batch:
             for e in t.entries:
+                if e.index <= self._index:
+                    # already applied: a snapshot recovery can leapfrog
+                    # entry tasks that were queued before it (the reference
+                    # tolerates the same overlap, statemachine.go onUpdate)
+                    continue
                 if (
                     not e.is_config_change()
                     and e.is_update()

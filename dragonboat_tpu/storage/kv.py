@@ -264,6 +264,8 @@ class WalKV(IKVStore):
 
     def close(self) -> None:
         with self._mu:
+            if self._f.closed:
+                return  # idempotent (stop paths can race teardown)
             try:
                 self._f.flush()
                 if self._fsync:

@@ -84,12 +84,54 @@ class Chunks:
         final_dir = os.path.join(base, f"snapshot-{c.index:016X}")
         tmp_dir = final_dir + ".receiving"
         if os.path.exists(final_dir):
-            return None  # already have this snapshot
+            # A finalized image already exists: its InstallSnapshot handoff
+            # was lost (the receiver was partitioned or mid-restart at
+            # finalize time). Rejecting the retry would poison EVERY
+            # subsequent stream of this index — the observed chaos wedge
+            # (hundreds of failed re-streams, zero recoveries). Re-deliver
+            # from the on-disk image; external-file metadata was persisted
+            # next to it at finalize time. The image is NEVER deleted here:
+            # it may be the node's only durable copy of an installed
+            # snapshot.
+            self._redeliver(c, final_dir)
+            return None
         os.makedirs(tmp_dir, exist_ok=True)
         t = _Track(c, tmp_dir, final_dir)
         t.tick = self._tick
         self._tracked[self._key(c)] = t
         return t
+
+    def _redeliver(self, c: SnapshotChunk, final_dir: str) -> None:
+        """Hand an already-received snapshot image to the node again (the
+        stream that produced it finished, but the receiving raft never saw
+        the InstallSnapshot). The stale-snapshot ACK path in the engine
+        covers the 'already recovered' case."""
+        fname = f"snapshot-{c.index:016X}.gbsnap"
+        final_path = os.path.join(final_dir, fname)
+        ss = Snapshot(
+            filepath=final_path,
+            file_size=(
+                os.path.getsize(final_path)
+                if not c.witness and os.path.exists(final_path)
+                else 0
+            ),
+            index=c.index,
+            term=c.term,
+            membership=c.membership,
+            files=self._load_stream_files(final_dir),
+            cluster_id=c.cluster_id,
+            on_disk_index=c.on_disk_index,
+            witness=c.witness,
+        )
+        m = Message(
+            type=MessageType.INSTALL_SNAPSHOT,
+            cluster_id=c.cluster_id,
+            to=c.node_id,
+            from_=c.from_,
+            snapshot=ss,
+        )
+        self._nh.handle_message_batch(MessageBatch(requests=[m]))
+        self._nh.handle_snapshot(c.cluster_id, c.node_id, c.from_)
 
     def _save_chunk(self, t: _Track, c: SnapshotChunk) -> None:
         if c.witness:
@@ -117,6 +159,24 @@ class Chunks:
         if os.path.exists(t.final_dir):
             shutil.rmtree(t.tmp_dir, ignore_errors=True)
             return True
+        # persist external-file metadata next to the image: a lost
+        # InstallSnapshot handoff is re-delivered from disk later, and the
+        # stream is the only carrier of this metadata
+        if t.files:
+            import json
+
+            meta = [
+                {
+                    "name": os.path.basename(lp),
+                    "file_id": fi.file_id,
+                    "metadata": fi.metadata.hex() if fi.metadata else "",
+                }
+                for fi, lp in t.files
+            ]
+            with open(
+                os.path.join(t.tmp_dir, "stream-files.json"), "w"
+            ) as mf:
+                json.dump(meta, mf)
         os.replace(t.tmp_dir, t.final_dir)
         final_path = os.path.join(t.final_dir, fname)
         from ..types import SnapshotFile as WireFile
@@ -151,6 +211,35 @@ class Chunks:
         self._nh.handle_message_batch(MessageBatch(requests=[m]))
         self._nh.handle_snapshot(first.cluster_id, first.node_id, first.from_)
         return True
+
+    def _load_stream_files(self, final_dir: str):
+        """External-file records persisted at finalize (for re-delivery)."""
+        path = os.path.join(final_dir, "stream-files.json")
+        if not os.path.exists(path):
+            return []
+        import json
+
+        from ..types import SnapshotFile as WireFile
+
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+            out = []
+            for rec in meta:
+                lp = os.path.join(final_dir, rec["name"])
+                out.append(
+                    WireFile(
+                        filepath=lp,
+                        file_size=(
+                            os.path.getsize(lp) if os.path.exists(lp) else 0
+                        ),
+                        file_id=rec["file_id"],
+                        metadata=bytes.fromhex(rec["metadata"]),
+                    )
+                )
+            return out
+        except Exception:
+            return []
 
     def _drop(self, key) -> None:
         t = self._tracked.pop(key, None)
