@@ -100,6 +100,15 @@ class EngineConfig:
     # ring-slot scatter is O(G*W) regardless of this value, so raising it
     # widens per-step ingestion at the cost of inbox transfer size only.
     max_entries_per_msg: int = 8
+    # Pipeline the engine loop: dispatch kernel step t, then decode step
+    # t-1's output while the device computes. Removes the device wait from
+    # the loop's critical path (a ~2x step rate on accelerators, where the
+    # wait is real idle time; on the cpu backend the "wait" is the host
+    # computing the kernel, so there is nothing to reclaim and the extra
+    # step of latency only hurts). None = auto: on for accelerators, off
+    # for cpu. Costs one extra step of pack staleness, which the window
+    # throttle accounts for.
+    overlap_decode: "Optional[bool]" = None
     # Co-hosted engine sharing: NodeHosts in one process constructed with
     # the same non-None scope string share ONE VectorEngine device state, so
     # all their replicas advance in a single kernel step and messages

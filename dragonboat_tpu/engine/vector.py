@@ -416,6 +416,7 @@ class _Lane:
         "staged_ccs",
         "msg_backlog",
         "pack_info",
+        "packed_pending",
         "ri_pending",
         "recovering",
         "adopted_term",
@@ -440,6 +441,7 @@ class _Lane:
         self.staged_ccs: deque = deque()  # (Entry, key)
         self.msg_backlog: deque = deque()  # wire Messages awaiting a slot
         self.pack_info: Dict[int, tuple] = {}
+        self.packed_pending = 0  # entries packed into not-yet-decoded steps
         self.ri_pending: Dict[Tuple[int, int], SystemCtx] = {}  # (lo,hi)->ctx
         self.recovering = False
         # term adopted from an InstallSnapshot sender; the restore ack must
@@ -562,6 +564,12 @@ class VectorEngine:
 
             self._sharding = _shard_for
         self.clock = _SharedClock()
+        ov = getattr(ecfg, "overlap_decode", None) if ecfg else None
+        if ov is None:
+            ov = jax.default_backend() != "cpu"  # auto: see EngineConfig
+        self._overlap = bool(ov)
+        self._pending = None  # in-flight (work, packs, StepOutput future)
+        self._rebase_due = False
         # stage profiler for the hot loop (cf. reference execengine.go
         # :197-211 + trace.go:98-162); every step is recorded — the cost is
         # two clock reads per stage, noise next to a kernel launch
@@ -635,31 +643,38 @@ class VectorEngine:
             self._threads.append(t)
 
     def _alloc_buffers(self) -> None:
-        # numpy staging buffers for the inbox (reused across steps)
+        # numpy staging buffers for the inbox. TWO sets: with overlapped
+        # decode, step t's buffers must stay untouched while the device may
+        # still be reading them, so pack alternates between the sets.
         G, K = self.kcfg.groups, self.kcfg.inbox_depth
         E = self.kcfg.max_entries_per_msg
-        self._buf = {
-            "mtype": np.full((G, K), MSG.NONE, np.int32),
-            "from_slot": np.zeros((G, K), np.int32),
-            "term": np.zeros((G, K), np.int32),
-            "log_index": np.zeros((G, K), np.int32),
-            "log_term": np.zeros((G, K), np.int32),
-            "commit": np.zeros((G, K), np.int32),
-            "reject": np.zeros((G, K), bool),
-            "hint": np.zeros((G, K), np.int32),
-            "hint_high": np.zeros((G, K), np.int32),
-            "n_entries": np.zeros((G, K), np.int32),
-            "entry_terms": np.zeros((G, K, E), np.int32),
-            "entry_cc": np.zeros((G, K, E), bool),
-        }
-        self._ticks = np.zeros((G,), np.int32)
-        # the buffers are mutated in place and never rebound, so the
-        # Inbox view over them — and, when sharded, the matching sharding
-        # pytree for the one-call device_put — are built exactly once
-        self._host_inbox = Inbox(**{
-            f: self._buf[f] for f in Inbox._fields
-        })
+
+        def mk():
+            return {
+                "mtype": np.full((G, K), MSG.NONE, np.int32),
+                "from_slot": np.zeros((G, K), np.int32),
+                "term": np.zeros((G, K), np.int32),
+                "log_index": np.zeros((G, K), np.int32),
+                "log_term": np.zeros((G, K), np.int32),
+                "commit": np.zeros((G, K), np.int32),
+                "reject": np.zeros((G, K), bool),
+                "hint": np.zeros((G, K), np.int32),
+                "hint_high": np.zeros((G, K), np.int32),
+                "n_entries": np.zeros((G, K), np.int32),
+                "entry_terms": np.zeros((G, K, E), np.int32),
+                "entry_cc": np.zeros((G, K, E), bool),
+            }
+
+        self._bufsets = []
+        for _ in range(2 if self._overlap else 1):
+            buf = mk()
+            ticks = np.zeros((G,), np.int32)
+            inbox = Inbox(**{f: buf[f] for f in Inbox._fields})
+            self._bufsets.append((buf, ticks, inbox))
+        self._buf_idx = 0
+        self._buf, self._ticks, self._host_inbox = self._bufsets[0]
         if self._sharding is not None:
+            # shapes identical across the sets: one sharding pytree serves
             self._inbox_shardings = (
                 jax.tree_util.tree_map(self._sharding, self._host_inbox),
                 self._sharding(self._ticks),
@@ -820,13 +835,19 @@ class VectorEngine:
             self._ready.wait(period)
             self._ready.clear()
             if self._stopped.is_set():
-                return
+                break
             try:
                 self._run_once()
             except Exception:
                 import traceback
 
                 traceback.print_exc()
+        try:
+            self._flush_pending()  # the last step's saves must land
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
     def snapshot_status_ready(self, node) -> None:
         with self._snap_status_mu:
@@ -834,6 +855,15 @@ class VectorEngine:
         self._ready.set()
 
     def _run_once(self) -> None:
+        # reconciles, snapshot finalization and rebase rewrite per-group
+        # mirrors (_m_base/_m_last/_lane_by_g); an undecoded in-flight step
+        # would later clobber them with stale device output, so these rare
+        # paths drain the pipeline first
+        if self._reconq or self._snap_status or self._rebase_due:
+            self._flush_pending()
+            if self._rebase_due:
+                self._rebase_due = False
+                self._do_rebase()
         self._apply_reconciles()
         with self._snap_status_mu:
             snap_done, self._snap_status = self._snap_status, set()
@@ -862,20 +892,35 @@ class VectorEngine:
         work |= self._catchups
         prof = self.profiler
         prof.new_iteration(len(work))
+        # swap to the idle buffer set BEFORE packing: the other set may
+        # still be read by the in-flight step
+        if self._overlap:
+            self._buf_idx = 1 - self._buf_idx
+            self._buf, self._ticks, self._host_inbox = self._bufsets[
+                self._buf_idx
+            ]
         prof.start()
-        had = self._pack(work)
+        had, packs = self._pack(work)
         prof.end("pack")
         if not had:
+            skip = False
             if ticks == 0:
-                return
-            # no active lanes: ticks have nobody to advance
-            act = self._m_active
-            if not act.any():
-                return
-            # a fully-quiesced fleet needs no kernel step for ticks: every
-            # timer is frozen, so the step would be a no-op (this is what
-            # makes 10k+ idle lanes cost zero host AND device work)
-            if bool(np.all(~act | self._m_quiesced)):
+                skip = True
+            else:
+                # no active lanes: ticks have nobody to advance
+                act = self._m_active
+                if not act.any():
+                    skip = True
+                # a fully-quiesced fleet needs no kernel step for ticks:
+                # every timer is frozen, so the step would be a no-op (this
+                # is what makes 10k+ idle lanes cost zero host AND device
+                # work)
+                elif bool(np.all(~act | self._m_quiesced)):
+                    skip = True
+            if skip:
+                # nothing new dispatched: the pipeline must not sit on an
+                # undecoded step indefinitely
+                self._flush_pending()
                 return
         if ticks:
             # per-lane tick counts come from the OWNING host's counter (a
@@ -895,7 +940,7 @@ class VectorEngine:
         # ONE device_put over the (inbox, ticks) pytree: 12 small host
         # arrays ship in a single batched transfer instead of 12 dispatch
         # round-trips (per-call overhead dominates at these sizes); the
-        # Inbox view and sharding pytree were built once at allocation
+        # Inbox views and sharding pytree were built once at allocation
         prof.start()
         if self._sharding is not None:
             inbox, tarr = jax.device_put(
@@ -904,10 +949,36 @@ class VectorEngine:
         else:
             inbox, tarr = jax.device_put((self._host_inbox, self._ticks))
         self._state, out = self._step_fn(self._state, inbox, tarr)
+        prof.end("dispatch")
+        if self._overlap:
+            # pipeline: decode step t-1 while the device computes step t
+            # (jax dispatch is async — `out` is a future). Ordering
+            # invariants live inside each step's decode, so pipelining
+            # steps preserves them; pack staleness is accounted for by the
+            # per-lane packed_pending window tracking. Swap FIRST so a
+            # decode exception cannot also lose the just-dispatched step.
+            pending, self._pending = self._pending, (work, packs, out)
+            self._flush_one(pending)
+        else:
+            prof.start()
+            o = jax.device_get(out)._asdict()
+            prof.end("step")
+            self._decode(work, packs, o)
+
+    def _flush_pending(self) -> None:
+        pending, self._pending = self._pending, None
+        self._flush_one(pending)
+
+    def _flush_one(self, pending) -> None:
+        if pending is None:
+            return
+        work, packs, out = pending
+        prof = self.profiler
+        prof.start()
         # ONE consolidated device->host transfer for the whole StepOutput
         o = jax.device_get(out)._asdict()
         prof.end("step")
-        self._decode(work, o)
+        self._decode(work, packs, o)
 
     def _run_gc(self, gc_cids) -> None:
         """Request-timeout pass over lanes with outstanding requests only
@@ -955,7 +1026,7 @@ class VectorEngine:
                 self._gc_set.difference_update(set(drop) - self._dirty)
 
     # ---------------------------------------------------------------- pack
-    def _pack(self, lanes: Set[_Lane]) -> bool:
+    def _pack(self, lanes: Set[_Lane]):
         K = self.kcfg.inbox_depth
         E = self.kcfg.max_entries_per_msg
         buf = self._buf
@@ -963,6 +1034,7 @@ class VectorEngine:
         buf["n_entries"].fill(0)
         buf["entry_cc"].fill(False)
         had = bool(self._catchups)
+        packs: Dict[_Lane, Dict[int, tuple]] = {}
         for lane in lanes:
             node = lane.node
             g = lane.g
@@ -1023,6 +1095,7 @@ class VectorEngine:
                     buf["entry_cc"][g, k, 0] = True
                     lane.pack_info[k] = ("cc", ce, key)
                     lane.cc_inflight = True
+                    lane.packed_pending += 1
                     had = True
                     k += 1
                 elif leader_nid is not None and leader_nid != node.node_id():
@@ -1045,13 +1118,14 @@ class VectorEngine:
                 if is_leader:
                     free = self.kcfg.log_window - 1 - int(
                         self._m_last[g] - self._m_devfirst[g] + 1
-                    )
+                    ) - lane.packed_pending
                     while lane.staged_props and k < K and free > 0:
                         ents = []
                         cap = min(E, free)
                         while lane.staged_props and len(ents) < cap:
                             ents.append(lane.staged_props.popleft()[0])
                         free -= len(ents)
+                        lane.packed_pending += len(ents)
                         self._pack_row(
                             g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
                             n_entries=len(ents),
@@ -1123,7 +1197,9 @@ class VectorEngine:
             # (K exhausted, or a leaderless lane waiting for an election)
             if lane.has_staged():
                 self._carry.add(lane)
-        return had
+            if lane.pack_info:
+                packs[lane] = lane.pack_info
+        return had, packs
 
     def _pack_row(
         self, g: int, k: int, mtype: int, from_slot: int = 0, term: int = 0,
@@ -1305,7 +1381,7 @@ class VectorEngine:
         lane.node._push_install_snapshot(ss)
 
     # --------------------------------------------------------------- decode
-    def _decode(self, worked: Set[_Lane], o: dict) -> None:
+    def _decode(self, worked: Set[_Lane], packs, o: dict) -> None:
         self.last_output = o  # numpy snapshot for diagnostics/tools
         prof = self.profiler
         prof.start()
@@ -1314,13 +1390,11 @@ class VectorEngine:
         updates: List[Update] = []
         lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
         # ---- phase 0: place payloads at device-assigned indexes ----------
-        for lane in worked:
-            if not lane.pack_info:
-                continue
+        for lane, pack_info in packs.items():
             g = lane.g
             b = int(base[g])
             node = lane.node
-            for k, info in lane.pack_info.items():
+            for k, info in pack_info.items():
                 kind = info[0]
                 if kind == "prop":
                     ents = info[1]
@@ -1359,7 +1433,9 @@ class VectorEngine:
                     if rbase > 0:
                         for e in info[1]:
                             lane.arena[e.index] = e
-            lane.pack_info = {}
+                if kind == "prop" or kind == "cc":
+                    n = len(info[1]) if kind == "prop" else 1
+                    lane.packed_pending = max(0, lane.packed_pending - n)
         # new-leader noop entries can appear on ANY lane (tick elections)
         for g in np.nonzero(o["noop_appended"])[0].tolist():
             lane = lane_by_g[g]
@@ -1968,7 +2044,11 @@ class VectorEngine:
                 marker_term=jnp.where(m, jnp.asarray(adv_term), s.marker_term),
             )
         if bool(np.any(o["last_index"] > _REBASE_THRESHOLD)):
-            self._do_rebase()
+            # never rebase under an in-flight step: the mirrors and the
+            # pending output would disagree by the rebase delta. The
+            # threshold leaves orders of magnitude more headroom than the
+            # one extra step this defers by.
+            self._rebase_due = True
 
     def _do_rebase(self) -> None:
         """Shift device indexes down so they never near 2**31. The delta is

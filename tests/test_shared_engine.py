@@ -134,3 +134,51 @@ def test_shared_release_keeps_core_alive(hosts):
     assert not core._stopped.is_set()
     # remaining hosts' lanes are still registered
     assert any(k[0] == hosts[2].engine.host for k in core._lanes)
+
+
+def test_overlapped_decode_pipeline(tmp_path):
+    """Forced overlap_decode (the accelerator default): dispatch step t,
+    decode t-1 while the device computes. Commits and reads must flow
+    unchanged through the pipelined loop."""
+    reg = _Registry()
+    hs = {}
+    for nid, addr in MEMBERS.items():
+        hs[nid] = NodeHost(NodeHostConfig(
+            raft_address=addr.replace("shared", "ovl"),
+            rtt_millisecond=10,
+            nodehost_dir=str(tmp_path / f"ovl{nid}"),
+            raft_rpc_factory=lambda a: loopback_factory(a, reg),
+            engine=EngineConfig(
+                kind="vector", max_groups=3 * GROUPS, max_peers=4,
+                log_window=64, inbox_depth=4, max_entries_per_msg=16,
+                share_scope="test-overlap", overlap_decode=True,
+            ),
+        ))
+    try:
+        assert hs[1].engine.core._overlap is True
+        for c in range(1, GROUPS + 1):
+            for nid in MEMBERS:
+                hs[nid].start_cluster(
+                    {n: a.replace("shared", "ovl") for n, a in MEMBERS.items()},
+                    False,
+                    lambda cid, nid_: _CounterSM(cid, nid_),
+                    Config(node_id=nid, cluster_id=c, election_rtt=20,
+                           heartbeat_rtt=2),
+                )
+        t0 = time.monotonic()
+        leaders = {}
+        while len(leaders) < GROUPS and time.monotonic() - t0 < 90:
+            snap = hs[1].engine.leader_snapshot()
+            leaders = {c: l for c, (l, _t) in snap.items() if l}
+            time.sleep(0.02)
+        assert len(leaders) == GROUPS
+        for c in range(1, GROUPS + 1):
+            nh = hs[leaders[c]]
+            h = nh.propose_batch_async(
+                nh.get_noop_session(c), [b"x"] * 96, 20
+            )
+            assert h.wait(20) and h.completed == 96, (c, h.completed, h.dropped)
+        assert hs[leaders[1]].sync_read(1, None) == 96
+    finally:
+        for nh in hs.values():
+            nh.stop()
