@@ -436,7 +436,7 @@ class _Lane:
         self.rev: Dict[int, int] = {}  # slot -> node_id
         # ring over the device window; real index -> Entry, size-tracked
         self.arena: _Arena = _Arena(node.engine.kcfg.log_window)
-        self.staged_props: deque = deque()  # (Entry, is_local)
+        self.staged_props: deque = deque()  # Entry
         self.staged_reads: deque = deque()  # RequestState
         self.staged_ccs: deque = deque()  # (Entry, key)
         self.msg_backlog: deque = deque()  # wire Messages awaiting a slot
@@ -1048,8 +1048,9 @@ class VectorEngine:
                     self._carry.add(lane)
                 continue
             # drain API queues into the staging deques
+            staged = lane.staged_props
             for e in node.incoming_proposals.get():
-                lane.staged_props.append((e, True))
+                staged.append(e)
             for rs in node.incoming_reads.get():
                 lane.staged_reads.append(rs)
             with node._mu:
@@ -1123,7 +1124,7 @@ class VectorEngine:
                         ents = []
                         cap = min(E, free)
                         while lane.staged_props and len(ents) < cap:
-                            ents.append(lane.staged_props.popleft()[0])
+                            ents.append(lane.staged_props.popleft())
                         free -= len(ents)
                         lane.packed_pending += len(ents)
                         self._pack_row(
@@ -1134,7 +1135,7 @@ class VectorEngine:
                         had = True
                         k += 1
                 elif leader_nid is not None and leader_nid != node.node_id():
-                    ents = [e for e, _ in lane.staged_props]
+                    ents = list(lane.staged_props)
                     lane.staged_props.clear()
                     for i in range(0, len(ents), 64):
                         node._send_message(
@@ -1232,7 +1233,7 @@ class VectorEngine:
                 if e.type == EntryType.CONFIG_CHANGE:
                     lane.staged_ccs.append((e, e.key))
                 else:
-                    lane.staged_props.append((e, False))
+                    lane.staged_props.append(e)
             return False
         if t == MT.QUIESCE:
             return False
