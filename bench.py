@@ -270,7 +270,7 @@ def _bench_e2e_body(
             engine=EngineConfig(
                 kind="vector",
                 max_groups=replicas * groups if shared else groups,
-                max_peers=8 if replicas > 4 else 4,
+                max_peers=max(replicas, 4),
                 log_window=log_window,
                 inbox_depth=inbox_depth,
                 max_entries_per_msg=entries_per_msg,
