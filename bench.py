@@ -31,7 +31,7 @@ import tempfile
 import time
 
 
-from dragonboat_tpu._jaxenv import maybe_pin_cpu, pin_cpu
+from dragonboat_tpu._jaxenv import enable_compile_cache, maybe_pin_cpu, pin_cpu
 
 BASELINE_PROPOSALS_PER_SEC = 9_000_000  # reference README.md:46 (3-node peak)
 
@@ -275,6 +275,10 @@ def _bench_e2e_body(
                 inbox_depth=inbox_depth,
                 max_entries_per_msg=entries_per_msg,
                 share_scope="bench" if shared else None,
+                # full stage sampling: the BENCH JSON carries per-stage
+                # host timings so the perf trajectory tracks where the
+                # host half of each step goes
+                profile_sample_ratio=1,
             ),
         )
         hosts[nid] = NodeHost(cfg)
@@ -440,6 +444,7 @@ def _bench_e2e_body(
             if rs.result is not None and rs.result.completed:
                 reads_done += 1
     dt = time.perf_counter() - t0
+    host_stages = _host_stage_report(hosts)
     out = {
         "value": (total + reads_done) / dt,
         "groups": groups,
@@ -462,6 +467,42 @@ def _bench_e2e_body(
     if churn:
         out["snapshots_requested"] = churn_state["snapshots"]
         out["membership_changes"] = churn_state["membership"]
+    if host_stages:
+        out.update(host_stages)
+    return out
+
+
+# vector-engine profiler stages making up the host fan-out half of a step
+# (everything between the device fetch and the next pack)
+_FANOUT_STAGES = ("place", "send_rep", "send_resp", "apply")
+
+
+def _host_stage_report(hosts) -> dict:
+    """Per-stage host timings from the engine's stage profiler: total
+    seconds per stage (pack / device dispatch+step / fan-out / save) plus
+    the fan-out+pack share of step wall time — the number the columnar
+    host dataflow is accountable to."""
+    # aggregate over every DISTINCT engine profiler: shared cores hand all
+    # hosts the same object (counted once); shared=False runs sum the
+    # per-host engines so the totals cover the whole run's host work
+    profs = {}
+    for nh in hosts.values():
+        prof = getattr(nh.engine, "profiler", None)
+        if prof is not None:
+            profs[id(prof)] = prof
+    totals_raw: dict = {}
+    for prof in profs.values():
+        for name, s in prof.summary().items():
+            totals_raw[name] = totals_raw.get(name, 0.0) + s["total_s"]
+    if not totals_raw:
+        return {}
+    totals = {name: round(v, 4) for name, v in totals_raw.items()}
+    wall = sum(totals_raw.values())
+    fanout = sum(totals_raw.get(n, 0.0) for n in _FANOUT_STAGES)
+    pack = totals_raw.get("pack", 0.0)
+    out = {"host_stage_total_s": totals}
+    if wall > 0:
+        out["fanout_pack_share"] = round((fanout + pack) / wall, 4)
     return out
 
 
@@ -613,6 +654,9 @@ def main() -> None:
     # ALWAYS armed — a CPU run can wedge on a deadlock just like the
     # tunnel can post-probe; partial ladder results still get printed
     watchdog = _arm_watchdog(args.watchdog_s, platform)
+    # warm XLA compiles across bench runs (each ladder config's engine
+    # shape costs seconds of compile; the cache makes reruns start warm)
+    enable_compile_cache()
 
     RECORD["platform"] = platform
     if platform == "cpu-fallback":

@@ -109,6 +109,11 @@ class EngineConfig:
     # for cpu. Costs one extra step of pack staleness, which the window
     # throttle accounts for.
     overlap_decode: "Optional[bool]" = None
+    # Stage-profiler sampling for the vector engine hot loop: 0 = sparse
+    # default (1 in 32 iterations — steady-state cost is two clock reads
+    # per stage only on sampled iterations), 1 = record every step (full
+    # stage timings; benches and debugging), N>1 = sample 1/N.
+    profile_sample_ratio: int = 0
     # Co-hosted engine sharing: NodeHosts in one process constructed with
     # the same non-None scope string share ONE VectorEngine device state, so
     # all their replicas advance in a single kernel step and messages

@@ -76,6 +76,7 @@ class Node:
         engine,
         event_listener=None,
         rng: Optional[random.Random] = None,
+        send_messages: Optional[Callable[[List[Message]], None]] = None,
     ) -> None:
         self.config = cfg
         self.cluster_id = cfg.cluster_id
@@ -84,6 +85,9 @@ class Node:
         self.logdb = logdb
         self.snapshotter = snapshotter
         self._send_message = send_message
+        # optional bulk path (one co-hosted delivery pass + one grouped
+        # wire send per batch); None falls back to per-message sends
+        self._send_messages = send_messages
         self.engine = engine
         self.events = event_listener
         self.clock = self._make_clock(engine)

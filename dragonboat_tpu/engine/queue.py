@@ -37,6 +37,12 @@ class EntryQueue:
             buf.append(e)
             return True
 
+    def has_pending(self) -> bool:
+        """Lock-free emptiness probe for the engine's pack loop: a racy
+        miss is safe because every producer marks the lane dirty AFTER
+        enqueueing, so the next iteration drains what this one missed."""
+        return bool(self._left or self._right)
+
     def add_many(self, entries: List[Entry]) -> int:
         """Enqueue a batch under ONE lock acquisition; returns how many
         were accepted (the tail past capacity is refused and the queue
@@ -130,12 +136,36 @@ class MessageQueue:
             self._msgs.append(m)
             return True
 
+    def add_many(self, msgs: List[Message]) -> int:
+        """Enqueue a batch under ONE lock acquisition; returns how many
+        were consumed (capacity refuses the tail, exactly like a failed
+        add — the caller routes the remainder through the wire path)."""
+        with self._mu:
+            if self.stopped:
+                return 0
+            n = 0
+            buf = self._msgs
+            size = self._size
+            for m in msgs:
+                if m.type == MessageType.LOCAL_TICK:
+                    self._tick_count += 1
+                elif len(buf) >= size:
+                    break
+                else:
+                    buf.append(m)
+                n += 1
+            return n
+
     def add_snapshot(self, m: Message) -> bool:
         with self._mu:
             if self.stopped or self._snapshot is not None:
                 return False
             self._snapshot = m
             return True
+
+    def has_pending(self) -> bool:
+        """Lock-free emptiness probe (see EntryQueue.has_pending)."""
+        return bool(self._msgs or self._snapshot or self._tick_count)
 
     def get(self) -> Tuple[List[Message], int]:
         """Returns (messages, coalesced_tick_count); an InstallSnapshot

@@ -25,6 +25,37 @@ per-lane protocol mirrors live in whole-G numpy arrays refreshed from one
 scatter per state field instead of per-lane device dispatches. Idle lanes
 cost zero host work per step.
 
+Columnar host dataflow (the step loop's host half stays O(active lanes),
+never O(messages) Python):
+
+  pack    - inbox rows are STAGED as column lists (_stage_row) and land in
+            the numpy planes as one fancy-indexed scatter per plane
+            (_flush_staged_rows), not ten scalar stores per message;
+            per-lane mirror reads are gathered once per step as columns.
+  fetch   - ONE consolidated device->host transfer of the StepOutput per
+            step (_fetch_output, shared by the overlap/non-overlap paths).
+            The planes ship together because on every backend the batched
+            transfer beats per-plane masked fetches: the arrays are small
+            (G- and GxP-sized) and per-dispatch overhead dominates.
+  fan-out - each decode phase derives its (g, p)/(g, k) work list from one
+            np.nonzero and gathers every needed field as whole columns
+            (`arr[gs, ps].tolist()`), so the per-message Python is just
+            tuple unpacking + Message construction at the transport
+            boundary; batches leave through Node._send_messages ->
+            NodeHost._send_messages -> VectorEngine.try_local_deliver_many
+            (one queue lock + one wake per destination lane) or
+            Transport.send_many (grouped per target address).
+  save    - every lane's per-step save is ONE multi-group write wave:
+            a single write-batch per touched logdb shard with the
+            durability barrier deferred, then one parallel sync over all
+            touched WALs (storage/logdb.save_raft_state_deferred +
+            storage/kv.sync_all), so a step pays max(fsync) not sum.
+
+This is what closed the 340x kernel-vs-e2e gap of the scalar-dispatch
+host loop (BENCH_r05: 7.9M kernel proposals/s vs 23k e2e): the kernel
+advances all groups in one compiled step, and the host now fans its
+output out in whole-plane numpy instead of per-(group, peer) Python.
+
 Payload bytes never touch the device: the kernel works on (index, term,
 is_cc) metadata while the engine keeps an arena of Entry objects keyed by
 (lane, real index). The kernel reports where each proposal/replicate landed
@@ -70,6 +101,7 @@ from ..ops.state import (
 )
 from ..requests import LogicalClock
 from ..settings import soft
+from ..storage.kv import sync_all as _kv_sync_all
 from ..trace import Profiler
 from ..types import (
     Entry,
@@ -507,6 +539,285 @@ class _Lane:
         )
 
 
+# wire type for each device response-plane type (phase-3 fan-out)
+_RESP_WIRE = {
+    int(MSG.REPLICATE_RESP): MT.REPLICATE_RESP,
+    int(MSG.REQUEST_VOTE_RESP): MT.REQUEST_VOTE_RESP,
+    int(MSG.HEARTBEAT_RESP): MT.HEARTBEAT_RESP,
+    int(MSG.NOOP): MT.NOOP,
+}
+
+
+# ---------------------------------------------------------------------------
+# Columnar fan-out: StepOutput planes -> wire Messages.
+#
+# Each builder derives its work list from ONE np.nonzero over the relevant
+# mask, gathers every field it needs as whole columns (`arr[gs, ps]`), and
+# only then iterates plain python values — Message objects materialize at
+# the transport boundary and nowhere earlier. These are module-level pure
+# readers (they mutate no engine state) so the differential test can drive
+# them directly against a per-element reference (tests/test_fanout_columnar).
+# ---------------------------------------------------------------------------
+
+
+def _send_target(lane_by_g, g: int, p: int):
+    """The fan-out builders' shared skip rules: (lane, to_nid), or None
+    when the lane is unoccupied or the peer slot has no known node id.
+    One place to extend when a new skip rule applies to every send kind."""
+    lane = lane_by_g[g]
+    if lane is None:
+        return None
+    to_nid = lane.rev.get(p)
+    if to_nid is None:
+        return None
+    return lane, to_nid
+
+
+def gather_replicate_sends(
+    o: dict, base, lane_by_g, fetch_from_log=None
+) -> List[Tuple[_Lane, Message]]:
+    """Phase-1 Replicate materialization (these leave BEFORE the fsync)."""
+    sends: List[Tuple[_Lane, Message]] = []
+    gs, ps = np.nonzero(o["send_flags"] & SEND_REPLICATE)
+    if not gs.size:
+        return sends
+    cols = zip(
+        gs.tolist(),
+        ps.tolist(),
+        base[gs].tolist(),
+        o["term"][gs].tolist(),
+        o["send_prev_index"][gs, ps].tolist(),
+        o["send_prev_term"][gs, ps].tolist(),
+        o["send_n_entries"][gs, ps].tolist(),
+        o["send_commit"][gs, ps].tolist(),
+    )
+    for g, p, b, term, prev, prev_term, n, commit in cols:
+        tgt = _send_target(lane_by_g, g, p)
+        if tgt is None:
+            continue
+        lane, to_nid = tgt
+        ents, _missing = lane.arena.get_run(b + prev + 1, b + prev + n)
+        if ents is None:
+            ents = (
+                fetch_from_log(lane, b + prev + 1, b + prev + n)
+                if fetch_from_log is not None
+                else None
+            )
+            if ents is None:
+                _plog.errorf(
+                    "%s missing entries for replicate [%d..%d]",
+                    lane.node.describe(), b + prev + 1, b + prev + n,
+                )
+                continue
+        sends.append(
+            (
+                lane,
+                Message(
+                    type=MT.REPLICATE,
+                    cluster_id=lane.node.cluster_id,
+                    to=to_nid,
+                    from_=lane.node.node_id(),
+                    term=term,
+                    log_index=b + prev,
+                    log_term=prev_term,
+                    commit=b + commit,
+                    entries=ents,
+                ),
+            )
+        )
+    return sends
+
+
+def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
+    """Phase-3 broadcast-plane sends (vote requests, heartbeats,
+    TimeoutNow), in the same per-kind order the scalar fan-out used."""
+    sends: List[Tuple[_Lane, Message]] = []
+    send_flags = o["send_flags"]
+    term_plane = o["term"]
+    gs, ps = np.nonzero(send_flags & SEND_VOTE_REQ)
+    if gs.size:
+        for g, p, b, term, vli, vlt, hint in zip(
+            gs.tolist(),
+            ps.tolist(),
+            base[gs].tolist(),
+            term_plane[gs].tolist(),
+            o["vote_last_index"][gs].tolist(),
+            o["vote_last_term"][gs].tolist(),
+            o["send_hint"][gs, ps].tolist(),
+        ):
+            tgt = _send_target(lane_by_g, g, p)
+            if tgt is None:
+                continue
+            lane, to_nid = tgt
+            sends.append(
+                (
+                    lane,
+                    Message(
+                        type=MT.REQUEST_VOTE,
+                        cluster_id=lane.node.cluster_id,
+                        to=to_nid,
+                        from_=lane.node.node_id(),
+                        term=term,
+                        log_index=b + vli,
+                        log_term=vlt,
+                        hint=hint,
+                    ),
+                )
+            )
+    gs, ps = np.nonzero(send_flags & SEND_HEARTBEAT)
+    if gs.size:
+        for g, p, b, term, hb_commit, hint, hint2 in zip(
+            gs.tolist(),
+            ps.tolist(),
+            base[gs].tolist(),
+            term_plane[gs].tolist(),
+            o["send_hb_commit"][gs, ps].tolist(),
+            o["send_hint"][gs, ps].tolist(),
+            o["send_hint2"][gs, ps].tolist(),
+        ):
+            tgt = _send_target(lane_by_g, g, p)
+            if tgt is None:
+                continue
+            lane, to_nid = tgt
+            sends.append(
+                (
+                    lane,
+                    Message(
+                        type=MT.HEARTBEAT,
+                        cluster_id=lane.node.cluster_id,
+                        to=to_nid,
+                        from_=lane.node.node_id(),
+                        term=term,
+                        commit=b + hb_commit,
+                        hint=hint,
+                        hint_high=hint2,
+                    ),
+                )
+            )
+    gs, ps = np.nonzero(send_flags & SEND_TIMEOUT_NOW)
+    if gs.size:
+        for g, p, term in zip(
+            gs.tolist(), ps.tolist(), term_plane[gs].tolist()
+        ):
+            tgt = _send_target(lane_by_g, g, p)
+            if tgt is None:
+                continue
+            lane, to_nid = tgt
+            sends.append(
+                (
+                    lane,
+                    Message(
+                        type=MT.TIMEOUT_NOW,
+                        cluster_id=lane.node.cluster_id,
+                        to=to_nid,
+                        from_=lane.node.node_id(),
+                        term=term,
+                    ),
+                )
+            )
+    return sends
+
+
+def gather_resp_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
+    """Phase-3 response-plane sends: one reply per consumed inbox slot."""
+    sends: List[Tuple[_Lane, Message]] = []
+    resp_type = o["resp_type"]
+    gs, ks = np.nonzero(resp_type != MSG.NONE)
+    if not gs.size:
+        return sends
+    cols = zip(
+        gs.tolist(),
+        base[gs].tolist(),
+        resp_type[gs, ks].tolist(),
+        o["resp_to"][gs, ks].tolist(),
+        o["resp_term"][gs, ks].tolist(),
+        o["resp_log_index"][gs, ks].tolist(),
+        o["resp_reject"][gs, ks].tolist(),
+        o["resp_hint"][gs, ks].tolist(),
+        o["resp_hint2"][gs, ks].tolist(),
+    )
+    for g, b, t, to_slot, term, log_index, reject, hint, hint2 in cols:
+        tgt = _send_target(lane_by_g, g, to_slot)
+        if tgt is None:
+            continue
+        lane, to_nid = tgt
+        if to_nid == lane.node.node_id():
+            continue  # self-addressed (e.g. local election artifacts)
+        wire = _RESP_WIRE.get(t)
+        if wire is None:
+            continue
+        if wire == MT.REPLICATE_RESP:
+            log_index += b
+            hint += b
+        sends.append(
+            (
+                lane,
+                Message(
+                    type=wire,
+                    cluster_id=lane.node.cluster_id,
+                    to=to_nid,
+                    from_=lane.node.node_id(),
+                    term=term,
+                    log_index=log_index,
+                    reject=bool(reject),
+                    hint=hint,
+                    hint_high=hint2,
+                ),
+            )
+        )
+    return sends
+
+
+def build_save_updates(o: dict, base, lane_by_g):
+    """Phase-2 hard-state/entry persistence as (updates, lane_saves): the
+    whole step's saves gathered columnar, written downstream as ONE
+    multi-group write wave."""
+    updates: List[Update] = []
+    lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
+    gs = np.nonzero((o["save_from"] > 0) | o["hard_changed"])[0]
+    if not gs.size:
+        return updates, lane_saves
+    cols = zip(
+        gs.tolist(),
+        base[gs].tolist(),
+        o["save_from"][gs].tolist(),
+        o["save_to"][gs].tolist(),
+        o["vote"][gs].tolist(),
+        o["term"][gs].tolist(),
+        o["commit_index"][gs].tolist(),
+        o["hard_changed"][gs].tolist(),
+    )
+    for g, b, sf, st_, vote_slot, term, commit, hard_changed in cols:
+        lane = lane_by_g[g]
+        if lane is None or not lane.active:
+            continue
+        ents: List[Entry] = []
+        if sf > 0:
+            ents, missing_at = lane.arena.get_run(b + sf, b + st_)
+            if ents is None:
+                _plog.errorf(
+                    "%s missing arena entry %d for save",
+                    lane.node.describe(), missing_at,
+                )
+                ents = []
+        state = State(
+            term=term,
+            vote=lane.rev.get(vote_slot - 1, 0) if vote_slot > 0 else 0,
+            commit=b + commit,
+        )
+        if ents or hard_changed:
+            updates.append(
+                Update(
+                    cluster_id=lane.node.cluster_id,
+                    node_id=lane.node.node_id(),
+                    state=state,
+                    entries_to_save=ents,
+                )
+            )
+            lane_saves.append((lane, ents, state))
+    return updates, lane_saves
+
+
 class VectorEngine:
     """Engine-compatible facade (add/remove/set_*_ready/stop) around the
     single-stepper loop that advances all lanes per kernel call."""
@@ -571,9 +882,12 @@ class VectorEngine:
         self._pending = None  # in-flight (work, packs, StepOutput future)
         self._rebase_due = False
         # stage profiler for the hot loop (cf. reference execengine.go
-        # :197-211 + trace.go:98-162); every step is recorded — the cost is
-        # two clock reads per stage, noise next to a kernel launch
-        self.profiler = Profiler(sample_ratio=1)
+        # :197-211 + trace.go:98-162). Sparse sampling by default (1/32):
+        # per-step full sampling is pure hot-loop overhead in production;
+        # benches and debugging opt into every-step recording through
+        # EngineConfig.profile_sample_ratio=1.
+        ratio = (getattr(ecfg, "profile_sample_ratio", 0) or 0) if ecfg else 0
+        self.profiler = Profiler(sample_ratio=ratio if ratio > 0 else 32)
         self._step_fn = make_step_fn(self.kcfg, donate=True)
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
@@ -673,6 +987,15 @@ class VectorEngine:
             self._bufsets.append((buf, ticks, inbox))
         self._buf_idx = 0
         self._buf, self._ticks, self._host_inbox = self._bufsets[0]
+        # columnar row staging for _pack: rows accumulate as python column
+        # lists and land in the numpy planes as ONE fancy-indexed scatter
+        # per plane (_flush_staged_rows) — list appends are ~4x cheaper
+        # than per-row scalar numpy stores across ten planes
+        self._rows = {
+            "g": [], "k": [], "mtype": [], "from_slot": [], "term": [],
+            "log_index": [], "log_term": [], "commit": [], "reject": [],
+            "hint": [], "hint_high": [], "n_entries": [], "ents": [],
+        }
         if self._sharding is not None:
             # shapes identical across the sets: one sharding pytree serves
             self._inbox_shardings = (
@@ -797,6 +1120,53 @@ class VectorEngine:
             return False
         self._wake(lane.key)
         return True
+
+    def try_local_deliver_many(self, msgs: List[Message]) -> List[Message]:
+        """Bulk co-hosted delivery: group the batch by destination lane,
+        enqueue each lane's messages under ONE queue lock, mark every
+        receiver dirty under ONE engine lock and wake the loop once.
+        Returns the messages that must ride the wire instead (no co-hosted
+        lane, stopped node, or a full receive queue — the same per-message
+        fallthrough try_local_deliver reports with False)."""
+        rest: List[Message] = []
+        by_lane: Dict[_Lane, List[Message]] = {}
+        route = self._route
+        blocked = self._blocked_hosts
+        hook = self._local_drop_hook
+        for m in msgs:
+            if m.type == MT.INSTALL_SNAPSHOT:
+                rest.append(m)
+                continue
+            lane = route.get((m.cluster_id, m.to))
+            if lane is None:
+                rest.append(m)
+                continue
+            if lane.key[0] in blocked:
+                continue  # partitioned receiver: drop like the wire path
+            if hook is not None and hook(m):
+                continue  # dropped by chaos hook
+            lst = by_lane.get(lane)
+            if lst is None:
+                lst = by_lane[lane] = []
+            lst.append(m)
+        if not by_lane:
+            return rest
+        woke = []
+        for lane, ms in by_lane.items():
+            node = lane.node
+            if node.stopped:
+                rest.extend(ms)
+                continue
+            taken = node.mq.add_many(ms)
+            if taken < len(ms):
+                rest.extend(ms[taken:])
+            if taken:
+                woke.append(lane.key)
+        if woke:
+            with self._dirty_mu:
+                self._dirty.update(woke)
+            self._ready.set()
+        return rest
 
     def set_host_partitioned(self, host: int, partitioned: bool) -> None:
         if partitioned:
@@ -960,10 +1330,20 @@ class VectorEngine:
             pending, self._pending = self._pending, (work, packs, out)
             self._flush_one(pending)
         else:
-            prof.start()
-            o = jax.device_get(out)._asdict()
-            prof.end("step")
-            self._decode(work, packs, o)
+            self._decode(work, packs, self._fetch_output(out))
+
+    def _fetch_output(self, out) -> dict:
+        """ONE consolidated device->host transfer for the whole StepOutput,
+        shared by the overlap and non-overlap paths. The planes ship as a
+        single batched fetch rather than per-plane masked gets: every plane
+        is G- or GxP-sized, so per-dispatch overhead dominates transfer
+        cost, and each decode phase masks its own work list host-side from
+        send_flags/dirty lanes."""
+        prof = self.profiler
+        prof.start()
+        o = jax.device_get(out)._asdict()
+        prof.end("step")
+        return o
 
     def _flush_pending(self) -> None:
         pending, self._pending = self._pending, None
@@ -973,12 +1353,7 @@ class VectorEngine:
         if pending is None:
             return
         work, packs, out = pending
-        prof = self.profiler
-        prof.start()
-        # ONE consolidated device->host transfer for the whole StepOutput
-        o = jax.device_get(out)._asdict()
-        prof.end("step")
-        self._decode(work, packs, o)
+        self._decode(work, packs, self._fetch_output(out))
 
     def _run_gc(self, gc_cids) -> None:
         """Request-timeout pass over lanes with outstanding requests only
@@ -1029,18 +1404,45 @@ class VectorEngine:
     def _pack(self, lanes: Set[_Lane]):
         K = self.kcfg.inbox_depth
         E = self.kcfg.max_entries_per_msg
+        W = self.kcfg.log_window
         buf = self._buf
         buf["mtype"].fill(MSG.NONE)
         buf["n_entries"].fill(0)
         buf["entry_cc"].fill(False)
+        # self-healing like the old direct writes: rows staged by an
+        # iteration that died mid-pack (loop catches and continues) must
+        # not replay into this step's planes as phantom kernel messages
+        for col in self._rows.values():
+            col.clear()
         had = bool(self._catchups)
         packs: Dict[_Lane, Dict[int, tuple]] = {}
-        for lane in lanes:
+        # per-lane mirror reads gathered ONCE as columns (per-element
+        # int(arr[g]) reads were a measured hot spot at fleet widths)
+        work = list(lanes)
+        if work:
+            w_gs = [lane.g for lane in work]
+            cols = zip(
+                work,
+                self._m_quiesced[w_gs].tolist(),
+                self._m_role[w_gs].tolist(),
+                self._m_leader[w_gs].tolist(),
+                self._m_last[w_gs].tolist(),
+                self._m_devfirst[w_gs].tolist(),
+                self._m_base[w_gs].tolist(),
+            )
+        else:
+            cols = ()
+        for lane, g_quiesced, g_role, g_leader, g_last, g_devfirst, b in cols:
             node = lane.node
             g = lane.g
             lane.pack_info = {}
-            msgs, _ = node.mq.get()
-            lane.msg_backlog.extend(msgs)
+            # queue drains gated on lock-free emptiness probes: producers
+            # mark the lane dirty AFTER enqueueing, so a racy miss is
+            # re-delivered next iteration; most dirty lanes carry only ONE
+            # kind of event and skip the other queues' lock round-trips
+            if node.mq.has_pending():
+                msgs, _ = node.mq.get()
+                lane.msg_backlog.extend(msgs)
             if lane.recovering:
                 # an InstallSnapshot recover is in flight: hold everything
                 # until the device lane is reconciled (cf. node.go:1199)
@@ -1048,30 +1450,30 @@ class VectorEngine:
                     self._carry.add(lane)
                 continue
             # drain API queues into the staging deques
-            staged = lane.staged_props
-            for e in node.incoming_proposals.get():
-                staged.append(e)
-            for rs in node.incoming_reads.get():
-                lane.staged_reads.append(rs)
-            with node._mu:
-                ccs, node._cc_queue = node._cc_queue, []
-            for cc, key in ccs:
-                ce = Entry(
-                    type=EntryType.CONFIG_CHANGE,
-                    cmd=encode_config_change(cc),
-                    key=key,
-                )
-                lane.staged_ccs.append((ce, key))
+            if node.incoming_proposals.has_pending():
+                lane.staged_props.extend(node.incoming_proposals.get())
+            if node.incoming_reads.has_pending():
+                lane.staged_reads.extend(node.incoming_reads.get())
+            if node._cc_queue:
+                with node._mu:
+                    ccs, node._cc_queue = node._cc_queue, []
+                for cc, key in ccs:
+                    ce = Entry(
+                        type=EntryType.CONFIG_CHANGE,
+                        cmd=encode_config_change(cc),
+                        key=key,
+                    )
+                    lane.staged_ccs.append((ce, key))
             k = 0
             # a quiesced lane with fresh host work gets a wake NOOP (the
             # kernel exits quiesce on any non-heartbeat inbox message; the
             # reference wakes through exitQuiesce on activity, quiesce.go)
             if (
-                self._m_quiesced[g]
+                g_quiesced
                 and k < K
                 and (lane.has_staged() or node.pending_leader_transfer.peek())
             ):
-                self._pack_row(
+                self._stage_row(
                     g, k, MSG.NOOP, from_slot=max(lane.self_slot(), 0)
                 )
                 had = True
@@ -1079,21 +1481,21 @@ class VectorEngine:
             # 1. wire/protocol messages first
             while lane.msg_backlog and k < K:
                 m = lane.msg_backlog.popleft()
-                k_used = self._pack_wire(lane, m, k)
+                k_used = self._pack_wire(lane, m, k, b)
                 if k_used:
                     had = True
                     k += 1
-            is_leader = self._m_role[g] == ROLE.LEADER
-            leader_nid = lane.rev.get(int(self._m_leader[g]) - 1)
+            is_leader = g_role == ROLE.LEADER
+            leader_nid = lane.rev.get(g_leader - 1)
             # 2. one config change per step (lone message; host invariant)
             if k < K and lane.staged_ccs and not lane.cc_inflight:
                 if is_leader:
                     ce, key = lane.staged_ccs.popleft()
-                    self._pack_row(
+                    self._stage_row(
                         g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
                         n_entries=1,
                     )
-                    buf["entry_cc"][g, k, 0] = True
+                    self._rows["ents"].append((g, k, None, (True,)))
                     lane.pack_info[k] = ("cc", ce, key)
                     lane.cc_inflight = True
                     lane.packed_pending += 1
@@ -1117,9 +1519,10 @@ class VectorEngine:
             # doesn't fit stays staged and re-packs after compaction
             if lane.staged_props:
                 if is_leader:
-                    free = self.kcfg.log_window - 1 - int(
-                        self._m_last[g] - self._m_devfirst[g] + 1
-                    ) - lane.packed_pending
+                    free = (
+                        W - 1 - (g_last - g_devfirst + 1)
+                        - lane.packed_pending
+                    )
                     while lane.staged_props and k < K and free > 0:
                         ents = []
                         cap = min(E, free)
@@ -1127,7 +1530,7 @@ class VectorEngine:
                             ents.append(lane.staged_props.popleft())
                         free -= len(ents)
                         lane.packed_pending += len(ents)
-                        self._pack_row(
+                        self._stage_row(
                             g, k, MSG.PROPOSE, from_slot=lane.self_slot(),
                             n_entries=len(ents),
                         )
@@ -1159,7 +1562,7 @@ class VectorEngine:
                         ):
                             enc = _enc_ctx(lane.self_slot(), ctx.low)
                             lane.ri_pending[enc] = ctx
-                            self._pack_row(
+                            self._stage_row(
                                 g, k, MSG.READ_INDEX,
                                 from_slot=lane.self_slot(), hint=enc[0],
                                 hint_high=enc[1],
@@ -1188,7 +1591,7 @@ class VectorEngine:
             if target is not None and k < K:
                 tslot = lane.slots.get(target, -1)
                 if tslot >= 0:
-                    self._pack_row(
+                    self._stage_row(
                         g, k, MSG.LEADER_TRANSFER,
                         from_slot=lane.self_slot(), hint=tslot + 1,
                     )
@@ -1200,29 +1603,63 @@ class VectorEngine:
                 self._carry.add(lane)
             if lane.pack_info:
                 packs[lane] = lane.pack_info
+        self._flush_staged_rows()
         return had, packs
 
-    def _pack_row(
+    def _stage_row(
         self, g: int, k: int, mtype: int, from_slot: int = 0, term: int = 0,
         log_index: int = 0, log_term: int = 0, commit: int = 0,
         reject: bool = False, hint: int = 0, hint_high: int = 0,
         n_entries: int = 0,
     ) -> None:
-        buf = self._buf
-        buf["mtype"][g, k] = mtype
-        buf["from_slot"][g, k] = max(from_slot, 0)
-        buf["term"][g, k] = term
-        buf["log_index"][g, k] = log_index
-        buf["log_term"][g, k] = log_term
-        buf["commit"][g, k] = commit
-        buf["reject"][g, k] = reject
-        buf["hint"][g, k] = hint
-        buf["hint_high"][g, k] = hint_high
-        buf["n_entries"][g, k] = n_entries
+        """Stage one inbox row as column appends; _flush_staged_rows lands
+        the whole step's rows with one scatter per plane."""
+        r = self._rows
+        r["g"].append(g)
+        r["k"].append(k)
+        r["mtype"].append(mtype)
+        r["from_slot"].append(max(from_slot, 0))
+        r["term"].append(term)
+        r["log_index"].append(log_index)
+        r["log_term"].append(log_term)
+        r["commit"].append(commit)
+        r["reject"].append(reject)
+        r["hint"].append(hint)
+        r["hint_high"].append(hint_high)
+        r["n_entries"].append(n_entries)
 
-    def _pack_wire(self, lane: _Lane, m: Message, k: int) -> bool:
-        """Convert one wire message into an inbox row. Returns False when
-        the message was consumed host-side (snapshot, propose staging)."""
+    def _flush_staged_rows(self) -> None:
+        rows = self._rows
+        gs = rows["g"]
+        if gs:
+            buf = self._buf
+            ks = rows["k"]
+            buf["mtype"][gs, ks] = rows["mtype"]
+            buf["from_slot"][gs, ks] = rows["from_slot"]
+            buf["term"][gs, ks] = rows["term"]
+            buf["log_index"][gs, ks] = rows["log_index"]
+            buf["log_term"][gs, ks] = rows["log_term"]
+            buf["commit"][gs, ks] = rows["commit"]
+            buf["reject"][gs, ks] = rows["reject"]
+            buf["hint"][gs, ks] = rows["hint"]
+            buf["hint_high"][gs, ks] = rows["hint_high"]
+            buf["n_entries"][gs, ks] = rows["n_entries"]
+            ents = rows["ents"]
+            if ents:
+                terms_buf = buf["entry_terms"]
+                cc_buf = buf["entry_cc"]
+                for g, k, terms, ccs in ents:
+                    if terms is not None:
+                        terms_buf[g, k, : len(terms)] = terms
+                    cc_buf[g, k, : len(ccs)] = ccs
+        for col in rows.values():
+            col.clear()
+
+    def _pack_wire(self, lane: _Lane, m: Message, k: int, b: int) -> bool:
+        """Convert one wire message into a staged inbox row (b = the lane's
+        device window base, gathered once per step by _pack). Returns False
+        when the message was consumed host-side (snapshot, propose
+        staging)."""
         g = lane.g
         t = m.type
         if t == MT.INSTALL_SNAPSHOT:
@@ -1240,7 +1677,6 @@ class VectorEngine:
         from_slot = lane.slot_of(m.from_, provisional=t == MT.REPLICATE or t == MT.HEARTBEAT or t == MT.REQUEST_VOTE or t == MT.TIMEOUT_NOW or t == MT.READ_INDEX_RESP)
         if from_slot < 0 and m.from_ != 0:
             return False  # unknown sender and no room to learn it
-        b = int(self._m_base[g])
         if t == MT.REPLICATE:
             n = len(m.entries)
             E = self.kcfg.max_entries_per_msg
@@ -1256,31 +1692,35 @@ class VectorEngine:
                 lane.msg_backlog.appendleft(rest)
                 m.entries = head
                 n = E
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.REPLICATE, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, log_term=m.log_term,
                 commit=max(m.commit - b, 0), n_entries=n,
             )
-            for i, e in enumerate(m.entries):
-                self._buf["entry_terms"][g, k, i] = e.term
-                self._buf["entry_cc"][g, k, i] = e.is_config_change()
+            self._rows["ents"].append(
+                (
+                    g, k,
+                    [e.term for e in m.entries],
+                    [e.is_config_change() for e in m.entries],
+                )
+            )
             lane.pack_info[k] = ("rep", list(m.entries))
             return True
         if t == MT.HEARTBEAT:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.HEARTBEAT, from_slot=from_slot, term=m.term,
                 commit=max(m.commit - b, 0), hint=m.hint,
                 hint_high=m.hint_high,
             )
             return True
         if t == MT.REQUEST_VOTE:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.REQUEST_VOTE, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, log_term=m.log_term, hint=m.hint,
             )
             return True
         if t == MT.REQUEST_VOTE_RESP:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.REQUEST_VOTE_RESP, from_slot=from_slot, term=m.term,
                 reject=m.reject,
             )
@@ -1294,46 +1734,46 @@ class VectorEngine:
                 # remote until the follower crosses the window base.
                 self._below_window_reject(lane, from_slot, m)
                 return False
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.REPLICATE_RESP, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, reject=m.reject,
                 hint=max(m.hint - b, 0),
             )
             return True
         if t == MT.HEARTBEAT_RESP:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.HEARTBEAT_RESP, from_slot=from_slot, term=m.term,
                 hint=m.hint, hint_high=m.hint_high,
             )
             return True
         if t == MT.READ_INDEX:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.READ_INDEX, from_slot=from_slot, term=m.term,
                 hint=m.hint, hint_high=m.hint_high,
             )
             return True
         if t == MT.READ_INDEX_RESP:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.READ_INDEX_RESP, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, hint=m.hint,
                 hint_high=m.hint_high,
             )
             return True
         if t == MT.TIMEOUT_NOW:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.TIMEOUT_NOW, from_slot=from_slot, term=m.term
             )
             return True
         if t == MT.UNREACHABLE:
-            self._pack_row(g, k, MSG.UNREACHABLE, from_slot=from_slot)
+            self._stage_row(g, k, MSG.UNREACHABLE, from_slot=from_slot)
             return True
         if t == MT.SNAPSHOT_STATUS:
-            self._pack_row(
+            self._stage_row(
                 g, k, MSG.SNAPSHOT_STATUS, from_slot=from_slot, reject=m.reject
             )
             return True
         if t == MT.NOOP:
-            self._pack_row(g, k, MSG.NOOP, from_slot=from_slot, term=m.term)
+            self._stage_row(g, k, MSG.NOOP, from_slot=from_slot, term=m.term)
             return True
         return False
 
@@ -1388,34 +1828,50 @@ class VectorEngine:
         prof.start()
         lane_by_g = self._lane_by_g
         base = self._m_base
-        updates: List[Update] = []
-        lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
         # ---- phase 0: place payloads at device-assigned indexes ----------
-        for lane, pack_info in packs.items():
-            g = lane.g
-            b = int(base[g])
-            node = lane.node
-            for k, info in pack_info.items():
+        # columnar: ONE gather per StepOutput plane over every packed row,
+        # then plain-python iteration (no per-element device_get reads)
+        if packs:
+            pk_lanes: List[_Lane] = []
+            pk_ks: List[int] = []
+            pk_infos: List[tuple] = []
+            for lane, pack_info in packs.items():
+                for k, info in pack_info.items():
+                    pk_lanes.append(lane)
+                    pk_ks.append(k)
+                    pk_infos.append(info)
+            pk_gs = [lane.g for lane in pk_lanes]
+            place_cols = zip(
+                pk_lanes,
+                pk_infos,
+                base[pk_gs].tolist(),
+                o["prop_base"][pk_gs, pk_ks].tolist(),
+                o["rep_base"][pk_gs, pk_ks].tolist(),
+                o["resp_term"][pk_gs, pk_ks].tolist(),
+                o["dropped_cc"][pk_gs].tolist(),
+            )
+            for lane, info, b, pbase, rbase, rterm, dcc in place_cols:
                 kind = info[0]
                 if kind == "prop":
                     ents = info[1]
-                    pbase = int(o["prop_base"][g, k])
                     if pbase > 0:
-                        term = int(o["resp_term"][g, k])
+                        arena = lane.arena
                         for i, e in enumerate(ents):
                             e.index = b + pbase + i
-                            e.term = term
-                            lane.arena[e.index] = e
+                            e.term = rterm
+                            arena[e.index] = e
                     else:
+                        node = lane.node
                         for e in ents:
                             node.proposal_dropped(e)
+                    lane.packed_pending = max(
+                        0, lane.packed_pending - len(ents)
+                    )
                 elif kind == "cc":
                     ce, key = info[1], info[2]
-                    pbase = int(o["prop_base"][g, k])
-                    stripped = bool(o["dropped_cc"][g])
-                    if pbase > 0 and not stripped:
+                    if pbase > 0 and not dcc:
                         ce.index = b + pbase
-                        ce.term = int(o["resp_term"][g, k])
+                        ce.term = rterm
                         lane.arena[ce.index] = ce
                     else:
                         if pbase > 0:
@@ -1425,29 +1881,35 @@ class VectorEngine:
                             lane.arena[b + pbase] = Entry(
                                 type=EntryType.APPLICATION,
                                 index=b + pbase,
-                                term=int(o["resp_term"][g, k]),
+                                term=rterm,
                             )
                         lane.cc_inflight = False
-                        node.pending_config_change.apply(key, rejected=True)
+                        lane.node.pending_config_change.apply(
+                            key, rejected=True
+                        )
+                    lane.packed_pending = max(0, lane.packed_pending - 1)
                 elif kind == "rep":
-                    rbase = int(o["rep_base"][g, k])
                     if rbase > 0:
+                        arena = lane.arena
                         for e in info[1]:
-                            lane.arena[e.index] = e
-                if kind == "prop" or kind == "cc":
-                    n = len(info[1]) if kind == "prop" else 1
-                    lane.packed_pending = max(0, lane.packed_pending - n)
+                            arena[e.index] = e
         # new-leader noop entries can appear on ANY lane (tick elections)
-        for g in np.nonzero(o["noop_appended"])[0].tolist():
-            lane = lane_by_g[g]
-            if lane is None:
-                continue
-            noop_at = int(o["noop_appended"][g])
-            lane.arena[int(base[g]) + noop_at] = Entry(
-                type=EntryType.APPLICATION,
-                term=int(o["noop_term"][g]),
-                index=int(base[g]) + noop_at,
-            )
+        noop_gs = np.nonzero(o["noop_appended"])[0]
+        if noop_gs.size:
+            for g, noop_at, noop_term, b in zip(
+                noop_gs.tolist(),
+                o["noop_appended"][noop_gs].tolist(),
+                o["noop_term"][noop_gs].tolist(),
+                base[noop_gs].tolist(),
+            ):
+                lane = lane_by_g[g]
+                if lane is None:
+                    continue
+                lane.arena[b + noop_at] = Entry(
+                    type=EntryType.APPLICATION,
+                    term=noop_term,
+                    index=b + noop_at,
+                )
         # ---- mirror refresh + leader-change events -----------------------
         new_leader = o["leader"]
         new_term = o["term"]
@@ -1463,102 +1925,28 @@ class VectorEngine:
         self._m_quiesced = np.array(o["quiesced"])
         self._m_commit = o["commit_index"].astype(np.int64)
         self._m_last = o["last_index"].astype(np.int64)
-        for g in changed.tolist():
-            lane = lane_by_g[g]
-            if lane is None or not lane.active:
-                continue
-            nid = lane.rev.get(int(new_leader[g]) - 1, 0)
-            lane.node._leader_event(nid, int(new_term[g]))
+        if changed.size:
+            for g, lslot, term in zip(
+                changed.tolist(),
+                new_leader[changed].tolist(),
+                new_term[changed].tolist(),
+            ):
+                lane = lane_by_g[g]
+                if lane is None or not lane.active:
+                    continue
+                lane.node._leader_event(lane.rev.get(lslot - 1, 0), term)
         prof.end("place")
         # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
         prof.start()
-        send_flags = o["send_flags"]
-        rep_gs, rep_ps = np.nonzero(send_flags & SEND_REPLICATE)
-        for g, p in zip(rep_gs.tolist(), rep_ps.tolist()):
-            lane = lane_by_g[g]
-            if lane is None:
-                continue
-            to_nid = lane.rev.get(p)
-            if to_nid is None:
-                continue
-            b = int(base[g])
-            prev = int(o["send_prev_index"][g, p])
-            n = int(o["send_n_entries"][g, p])
-            try:
-                ents = [lane.arena[b + prev + 1 + i] for i in range(n)]
-            except KeyError:
-                ents = self._fetch_from_log(lane, b + prev + 1, b + prev + n)
-                if ents is None:
-                    _plog.errorf(
-                        "%s missing entries for replicate [%d..%d]",
-                        lane.node.describe(), b + prev + 1, b + prev + n,
-                    )
-                    continue
-            lane.node._send_message(
-                Message(
-                    type=MT.REPLICATE,
-                    cluster_id=lane.node.cluster_id,
-                    to=to_nid,
-                    from_=lane.node.node_id(),
-                    term=int(o["term"][g]),
-                    log_index=b + prev,
-                    log_term=int(o["send_prev_term"][g, p]),
-                    commit=b + int(o["send_commit"][g, p]),
-                    entries=ents,
-                )
-            )
+        self._dispatch_sends(
+            gather_replicate_sends(o, base, lane_by_g, self._fetch_from_log)
+        )
         prof.end("send_rep")
         # ---- phase 2: one batched fsynced write for every lane -----------
         prof.start()
-        save_gs = np.nonzero((o["save_from"] > 0) | o["hard_changed"])[0]
-        for g in save_gs.tolist():
-            lane = lane_by_g[g]
-            if lane is None or not lane.active:
-                continue
-            b = int(base[g])
-            sf, st_ = int(o["save_from"][g]), int(o["save_to"][g])
-            ents: List[Entry] = []
-            if sf > 0:
-                ents, missing_at = lane.arena.get_run(b + sf, b + st_)
-                if ents is None:
-                    _plog.errorf(
-                        "%s missing arena entry %d for save",
-                        lane.node.describe(), missing_at,
-                    )
-                    ents = []
-            vote_slot = int(o["vote"][g])
-            state = State(
-                term=int(o["term"][g]),
-                vote=lane.rev.get(vote_slot - 1, 0) if vote_slot > 0 else 0,
-                commit=b + int(o["commit_index"][g]),
-            )
-            if ents or bool(o["hard_changed"][g]):
-                updates.append(
-                    Update(
-                        cluster_id=lane.node.cluster_id,
-                        node_id=lane.node.node_id(),
-                        state=state,
-                        entries_to_save=ents,
-                    )
-                )
-                lane_saves.append((lane, ents, state))
+        updates, lane_saves = build_save_updates(o, base, lane_by_g)
         if updates:
-            # one batched fsynced write per backing logdb — a shared core
-            # hosts lanes from several NodeHosts, each with its own WAL
-            if self._next_host <= 1:
-                self._logdb.save_raft_state(updates)
-            elif len(lane_saves) == 1:
-                lane_saves[0][0].node.logdb.save_raft_state(updates)
-            else:
-                by_db: Dict[int, tuple] = {}
-                for (lane, _e, _s), ud in zip(lane_saves, updates):
-                    db = lane.node.logdb
-                    ent = by_db.get(id(db))
-                    if ent is None:
-                        ent = by_db[id(db)] = (db, [])
-                    ent[1].append(ud)
-                for db, uds in by_db.values():
-                    db.save_raft_state(uds)
+            self._save_updates(updates, lane_saves)
         for lane, ents, state in lane_saves:
             if ents:
                 lane.node.log_reader.append(ents)
@@ -1566,83 +1954,86 @@ class VectorEngine:
         prof.end("save")
         # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
         prof.start()
-        for flag, mk in (
-            (SEND_VOTE_REQ, self._mk_vote),
-            (SEND_HEARTBEAT, self._mk_heartbeat),
-            (SEND_TIMEOUT_NOW, self._mk_timeout_now),
-        ):
-            gs, ps = np.nonzero(send_flags & flag)
-            for g, p in zip(gs.tolist(), ps.tolist()):
-                lane = lane_by_g[g]
-                if lane is None:
-                    continue
-                to_nid = lane.rev.get(p)
-                if to_nid is None:
-                    continue
-                lane.node._send_message(mk(lane, o, g, p, to_nid))
-        resp_gs, resp_ks = np.nonzero(o["resp_type"] != MSG.NONE)
-        for g, k in zip(resp_gs.tolist(), resp_ks.tolist()):
-            lane = lane_by_g[g]
-            if lane is None:
-                continue
-            self._send_resp(lane, o, g, k)
+        post = gather_post_sends(o, base, lane_by_g)
+        post.extend(gather_resp_sends(o, base, lane_by_g))
+        self._dispatch_sends(post)
         # snapshot path for peers that fell behind the device window
-        snap_gs, snap_ps = np.nonzero(send_flags & NEED_SNAPSHOT)
-        for g, p in zip(snap_gs.tolist(), snap_ps.tolist()):
-            lane = lane_by_g[g]
-            if lane is not None:
-                self._start_catchup(lane, p, o)
+        snap_gs, snap_ps = np.nonzero(o["send_flags"] & NEED_SNAPSHOT)
+        if snap_gs.size:
+            for g, p in zip(snap_gs.tolist(), snap_ps.tolist()):
+                lane = lane_by_g[g]
+                if lane is not None:
+                    self._start_catchup(lane, p, o)
         prof.end("send_resp")
         # ---- phase 4: hand committed entries to the RSM ------------------
         prof.start()
         from ..rsm import Task
 
         apply_gs = np.nonzero(o["apply_from"])[0]
-        for g in apply_gs.tolist():
-            lane = lane_by_g[g]
-            if lane is None or not lane.active:
-                continue
-            b = int(base[g])
-            af, at = int(o["apply_from"][g]), int(o["apply_to"][g])
-            ents, missing_at = lane.arena.get_run(b + af, b + at)
-            if ents is None:
-                # the ring only spans the device window; a restart replays
-                # the WHOLE committed log through the SM, whose early
-                # entries live in the host log alone
-                ents = self._fetch_from_log(lane, b + af, b + at)
-                if ents is None:
-                    _plog.errorf(
-                        "%s missing entry %d for apply (arena+log)",
-                        lane.node.describe(), missing_at,
-                    )
+        if apply_gs.size:
+            for g, b, af, at in zip(
+                apply_gs.tolist(),
+                base[apply_gs].tolist(),
+                o["apply_from"][apply_gs].tolist(),
+                o["apply_to"][apply_gs].tolist(),
+            ):
+                lane = lane_by_g[g]
+                if lane is None or not lane.active:
                     continue
-            if not ents:
-                continue
-            lane.node.sm.task_queue.add(
-                Task(
-                    cluster_id=lane.node.cluster_id,
-                    node_id=lane.node.node_id(),
-                    entries=ents,
+                ents, missing_at = lane.arena.get_run(b + af, b + at)
+                if ents is None:
+                    # the ring only spans the device window; a restart
+                    # replays the WHOLE committed log through the SM, whose
+                    # early entries live in the host log alone
+                    ents = self._fetch_from_log(lane, b + af, b + at)
+                    if ents is None:
+                        _plog.errorf(
+                            "%s missing entry %d for apply (arena+log)",
+                            lane.node.describe(), missing_at,
+                        )
+                        continue
+                if not ents:
+                    continue
+                lane.node.sm.task_queue.add(
+                    Task(
+                        cluster_id=lane.node.cluster_id,
+                        node_id=lane.node.node_id(),
+                        entries=ents,
+                    )
                 )
-            )
-            self._m_applied_since[g] += len(ents)
-            # committed + dispatched to the RSM: no longer memory pressure
-            lane.arena.mark_applied(b + at)
-            if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
-                lane.cc_inflight = False
-            self.set_task_ready(lane.key)
+                self._m_applied_since[g] += len(ents)
+                # committed + dispatched to the RSM: no longer mem pressure
+                lane.arena.mark_applied(b + at)
+                if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
+                    lane.cc_inflight = False
+                self.set_task_ready(lane.key)
         # ---- phase 5: confirmed reads ------------------------------------
-        ready_gs = np.nonzero(o["ready_count"])[0]
-        for g in ready_gs.tolist():
-            lane = lane_by_g[g]
-            if lane is None or not lane.active:
-                continue
-            n = int(o["ready_count"][g])
-            node = lane.node
-            for i in range(n):
-                enc = (int(o["ready_ctx"][g, i]), int(o["ready_ctx2"][g, i]))
-                idx = int(base[g]) + int(o["ready_index"][g, i])
-                origin = _ctx_origin(enc[0])
+        rc = o["ready_count"]
+        ready_gs = np.nonzero(rc)[0]
+        if ready_gs.size:
+            # flatten the (lane, slot<count) pairs, then gather columns
+            ridx = np.arange(o["ready_ctx"].shape[1])
+            rrow, ris = np.nonzero(ridx[None, :] < rc[ready_gs, None])
+            sel = ready_gs[rrow]
+            read_sends: List[Tuple[_Lane, Message]] = []
+            applied_lanes: Dict[_Lane, None] = {}
+            for g, _slot, b, enc_lo, enc_hi, dev_idx, term in zip(
+                sel.tolist(),
+                ris.tolist(),
+                base[sel].tolist(),
+                o["ready_ctx"][sel, ris].tolist(),
+                o["ready_ctx2"][sel, ris].tolist(),
+                o["ready_index"][sel, ris].tolist(),
+                self._m_term[sel].tolist(),
+            ):
+                lane = lane_by_g[g]
+                if lane is None or not lane.active:
+                    continue
+                node = lane.node
+                applied_lanes[lane] = None
+                enc = (enc_lo, enc_hi)
+                idx = b + dev_idx
+                origin = _ctx_origin(enc_lo)
                 if origin == lane.self_slot():
                     ctx = lane.ri_pending.pop(enc, None)
                     if ctx is not None:
@@ -1652,24 +2043,82 @@ class VectorEngine:
                 else:
                     to_nid = lane.rev.get(origin)
                     if to_nid is not None:
-                        node._send_message(
-                            Message(
-                                type=MT.READ_INDEX_RESP,
-                                cluster_id=node.cluster_id,
-                                to=to_nid,
-                                from_=node.node_id(),
-                                term=int(self._m_term[g]),
-                                log_index=idx,
-                                hint=enc[0],
-                                hint_high=enc[1],
+                        read_sends.append(
+                            (
+                                lane,
+                                Message(
+                                    type=MT.READ_INDEX_RESP,
+                                    cluster_id=node.cluster_id,
+                                    to=to_nid,
+                                    from_=node.node_id(),
+                                    term=term,
+                                    log_index=idx,
+                                    hint=enc_lo,
+                                    hint_high=enc_hi,
+                                ),
                             )
                         )
-            node.pending_read_indexes.applied(node.sm.last_applied_index())
+            self._dispatch_sends(read_sends)
+            for lane in applied_lanes:
+                lane.node.pending_read_indexes.applied(
+                    lane.node.sm.last_applied_index()
+                )
         prof.end("apply")
         # ---- phase 6: maintenance ----------------------------------------
         prof.start()
         self._maintain(o)
         prof.end("maintain")
+
+    def _dispatch_sends(self, sends: List[Tuple["_Lane", Message]]) -> None:
+        """Hand a decode phase's (lane, Message) batch to each owning
+        node's bulk send path: one co-hosted delivery pass plus one grouped
+        wire send per node, instead of a queue hop per message. Relative
+        order within the batch is preserved per destination."""
+        if not sends:
+            return
+        by_node: Dict[object, List[Message]] = {}
+        for lane, m in sends:
+            node = lane.node
+            lst = by_node.get(node)
+            if lst is None:
+                lst = by_node[node] = []
+            lst.append(m)
+        for node, msgs in by_node.items():
+            many = node._send_messages
+            if many is not None:
+                many(msgs)
+            else:
+                send = node._send_message
+                for m in msgs:
+                    send(m)
+
+    def _save_updates(self, updates: List[Update], lane_saves) -> None:
+        """One multi-group write wave per step: a single write-batch per
+        touched logdb shard with the durability barrier deferred, then one
+        parallel sync over every touched WAL — group commit across shards
+        AND across co-hosted NodeHosts' logdbs (a shared core hosts lanes
+        from several hosts, each with its own WAL)."""
+        if self._next_host <= 1:
+            self._logdb.save_raft_state(updates)
+            return
+        if len(lane_saves) == 1:
+            lane_saves[0][0].node.logdb.save_raft_state(updates)
+            return
+        by_db: Dict[int, tuple] = {}
+        for (lane, _e, _s), ud in zip(lane_saves, updates):
+            db = lane.node.logdb
+            ent = by_db.get(id(db))
+            if ent is None:
+                ent = by_db[id(db)] = (db, [])
+            ent[1].append(ud)
+        pending = []
+        for db, uds in by_db.values():
+            deferred = getattr(db, "save_raft_state_deferred", None)
+            if deferred is not None:
+                pending.extend(deferred(uds))
+            else:
+                db.save_raft_state(uds)
+        _kv_sync_all(pending)
 
     def _fetch_from_log(self, lane: _Lane, lo: int, hi: int):
         """Contiguous [lo, hi] from the host log (the arena ring's backing
@@ -1684,76 +2133,6 @@ class VectorEngine:
         ):
             return None
         return ents
-
-    def _mk_vote(self, lane, o, g, p, to_nid) -> Message:
-        return Message(
-            type=MT.REQUEST_VOTE,
-            cluster_id=lane.node.cluster_id,
-            to=to_nid,
-            from_=lane.node.node_id(),
-            term=int(o["term"][g]),
-            log_index=int(self._m_base[g]) + int(o["vote_last_index"][g]),
-            log_term=int(o["vote_last_term"][g]),
-            hint=int(o["send_hint"][g, p]),
-        )
-
-    def _mk_heartbeat(self, lane, o, g, p, to_nid) -> Message:
-        return Message(
-            type=MT.HEARTBEAT,
-            cluster_id=lane.node.cluster_id,
-            to=to_nid,
-            from_=lane.node.node_id(),
-            term=int(o["term"][g]),
-            commit=int(self._m_base[g]) + int(o["send_hb_commit"][g, p]),
-            hint=int(o["send_hint"][g, p]),
-            hint_high=int(o["send_hint2"][g, p]),
-        )
-
-    def _mk_timeout_now(self, lane, o, g, p, to_nid) -> Message:
-        return Message(
-            type=MT.TIMEOUT_NOW,
-            cluster_id=lane.node.cluster_id,
-            to=to_nid,
-            from_=lane.node.node_id(),
-            term=int(o["term"][g]),
-        )
-
-    def _send_resp(self, lane: _Lane, o, g: int, k: int) -> None:
-        t = int(o["resp_type"][g, k])
-        to_slot = int(o["resp_to"][g, k])
-        to_nid = lane.rev.get(to_slot)
-        if to_nid is None:
-            return
-        if to_nid == lane.node.node_id():
-            return  # self-addressed (e.g. local election artifacts)
-        b = int(self._m_base[g])
-        wire = {
-            MSG.REPLICATE_RESP: MT.REPLICATE_RESP,
-            MSG.REQUEST_VOTE_RESP: MT.REQUEST_VOTE_RESP,
-            MSG.HEARTBEAT_RESP: MT.HEARTBEAT_RESP,
-            MSG.NOOP: MT.NOOP,
-        }.get(t)
-        if wire is None:
-            return
-        log_index = int(o["resp_log_index"][g, k])
-        hint = int(o["resp_hint"][g, k])
-        if wire == MT.REPLICATE_RESP:
-            log_index += b
-            hint += b
-        lane.node._send_message(
-            Message(
-                type=wire,
-                cluster_id=lane.node.cluster_id,
-                to=to_nid,
-                from_=lane.node.node_id(),
-                term=int(o["resp_term"][g, k]),
-                log_index=log_index,
-                reject=bool(o["resp_reject"][g, k]),
-                hint=hint,
-                hint_high=int(o["resp_hint2"][g, k]),
-            )
-        )
-
     # ------------------------------------------------------ catchup path
     def _below_window_reject(self, lane: _Lane, p: int, m: Message) -> None:
         """A reject whose hint is below the device window base: replicate

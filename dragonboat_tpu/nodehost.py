@@ -366,6 +366,7 @@ class NodeHost(IMessageHandler):
             logdb=self.logdb,
             snapshotter=snapshotter,
             send_message=self._send_message,
+            send_messages=self._send_messages,
             engine=self.engine,
             event_listener=self._event_aggregator,
         )
@@ -752,6 +753,32 @@ class NodeHost(IMessageHandler):
         if deliver is not None and deliver(m):
             return
         self.transport.send(m)
+
+    def _send_messages(self, msgs) -> None:
+        """Bulk send: one co-hosted delivery pass (grouped per destination
+        lane, one queue lock + one wake per lane) and one grouped
+        transport.send_many for whatever must ride the wire. The engine's
+        columnar fan-out emits each step's messages through this seam
+        instead of per-message _send_message calls."""
+        if self._partitioned:
+            return
+        wire = []
+        for m in msgs:
+            if m.type == MessageType.INSTALL_SNAPSHOT:
+                self._async_send_snapshot(m)
+            else:
+                wire.append(m)
+        deliver_many = getattr(self.engine, "try_local_deliver_many", None)
+        if deliver_many is not None:
+            wire = deliver_many(wire)
+        if not wire:
+            return
+        send_many = getattr(self.transport, "send_many", None)
+        if send_many is not None:
+            send_many(wire)
+        else:
+            for m in wire:
+                self.transport.send(m)
 
     def _recv_chunk(self, chunk) -> bool:
         """Inbound chunk sink with the receive-side bandwidth cap: the

@@ -89,6 +89,23 @@ class IKVStore:
     def commit_write_batch(self, wb: WriteBatch) -> None:
         raise NotImplementedError
 
+    def commit_write_batch_deferred(self, wb: WriteBatch) -> bool:
+        """Apply a write batch with its durability barrier DEFERRED to a
+        later sync() call. Returns True when the caller owes a sync().
+
+        The group-commit seam for the engine's per-step multi-lane save:
+        every touched shard writes its batch first, then all barriers run
+        in one parallel wave (sync_all), so a step pays max(fsync) instead
+        of sum(fsync). Stores without a separate barrier (this default)
+        just commit durably and owe nothing."""
+        self.commit_write_batch(wb)
+        return False
+
+    def sync(self) -> None:
+        """Durability barrier for writes committed via
+        commit_write_batch_deferred. No-op unless overridden."""
+        return None
+
     def bulk_remove_entries(self, fk: bytes, lk: bytes) -> None:
         """Range delete [fk, lk)."""
         raise NotImplementedError
@@ -227,6 +244,25 @@ class WalKV(IKVStore):
             self._mem.commit_write_batch(wb)
             self._since_compact += len(wb.ops)
 
+    def commit_write_batch_deferred(self, wb: WriteBatch) -> bool:
+        """Append + flush the batch but leave the fsync to sync(): the
+        caller groups barriers across shards into one parallel wave. The
+        batch is NOT durable until that sync() returns."""
+        with self._mu:
+            for op, k, v in wb.ops:
+                self._append_rec(op, k, v)
+            self._f.flush()
+            self._mem.commit_write_batch(wb)
+            self._since_compact += len(wb.ops)
+        return self._fsync
+
+    def sync(self) -> None:
+        if not self._fsync:
+            return
+        with self._mu:
+            if not self._f.closed:
+                os.fsync(self._f.fileno())
+
     def bulk_remove_entries(self, fk, lk) -> None:
         wb = WriteBatch()
         wb.delete_range(fk, lk)
@@ -274,4 +310,55 @@ class WalKV(IKVStore):
                 self._f.close()
 
 
-__all__ = ["IKVStore", "WriteBatch", "MemKV", "WalKV"]
+# shared barrier pool for sync_all: fsync releases the GIL, so syncing N
+# shard WALs concurrently costs ~max(fsync) wall time instead of the sum.
+# Lazily created; sized for IO concurrency, not core count.
+_sync_pool = None
+_sync_pool_mu = threading.Lock()
+
+
+def _get_sync_pool():
+    global _sync_pool
+    if _sync_pool is None:
+        with _sync_pool_mu:
+            if _sync_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # sized to cover a full default save wave in ONE round:
+                # hard.logdb_pool_size shards per logdb, and a shared core
+                # can sync several co-hosted logdbs in the same barrier.
+                # fsync threads are IO-parked, not CPU contenders.
+                from ..settings import hard
+
+                _sync_pool = ThreadPoolExecutor(
+                    max_workers=max(2 * hard.logdb_pool_size, 8),
+                    thread_name_prefix="kv-sync",
+                )
+    return _sync_pool
+
+
+def sync_all(kvs) -> None:
+    """One durability barrier over many stores: fsync every store in
+    parallel and return once ALL are durable (the group-commit half of
+    commit_write_batch_deferred). Raises the first failure after every
+    sync has settled — a failed barrier must not report durable."""
+    unique = list(dict.fromkeys(kvs))
+    if not unique:
+        return
+    if len(unique) == 1:
+        unique[0].sync()
+        return
+    pool = _get_sync_pool()
+    futures = [pool.submit(kv.sync) for kv in unique]
+    first_exc = None
+    for f in futures:
+        try:
+            f.result()
+        except Exception as e:  # noqa: BLE001 - re-raised below
+            if first_exc is None:
+                first_exc = e
+    if first_exc is not None:
+        raise first_exc
+
+
+__all__ = ["IKVStore", "WriteBatch", "MemKV", "WalKV", "sync_all"]

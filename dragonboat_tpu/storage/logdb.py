@@ -23,7 +23,7 @@ from ..raftio import (
 from ..settings import hard
 from ..types import Bootstrap, Entry, Snapshot, State, Update
 from . import keys
-from .kv import IKVStore, MemKV, WalKV, WriteBatch
+from .kv import IKVStore, MemKV, WalKV, WriteBatch, sync_all
 
 
 class _Shard:
@@ -54,6 +54,17 @@ class _Shard:
             self._record_update(wb, ud)
         if wb.count() > 0:
             self.kv.commit_write_batch(wb)
+
+    def save_raft_state_deferred(self, updates: Sequence[Update]):
+        """Write one batch for `updates` with the durability barrier
+        deferred; returns the kv store owing a sync(), or None when
+        nothing was written (or the store needs no separate barrier)."""
+        wb = WriteBatch()
+        for ud in updates:
+            self._record_update(wb, ud)
+        if wb.count() > 0 and self.kv.commit_write_batch_deferred(wb):
+            return self.kv
+        return None
 
     def _save_entries(self, wb: WriteBatch, cid: int, nid: int, ents) -> None:
         """Pack entries into batch records, merging the head batch with any
@@ -289,12 +300,25 @@ class ShardedLogDB(ILogDB):
 
     # -- raft state ------------------------------------------------------------
     def save_raft_state(self, updates: Sequence[Update], shard_id: int = 0) -> None:
-        # group by shard; each group is one atomic fsynced batch
+        """Multi-lane save: ONE atomic write-batch per touched shard, then
+        one parallel group-commit barrier over all of them (the engine
+        hands every lane's per-step save through this single call)."""
+        sync_all(self.save_raft_state_deferred(updates))
+
+    def save_raft_state_deferred(self, updates: Sequence[Update]) -> list:
+        """Write one batch per touched shard with the durability barrier
+        deferred; returns the kv stores owing a sync (sync_all them). Lets
+        the engine group-commit saves spanning SEVERAL logdbs (a shared
+        core hosts lanes from many NodeHosts) in one barrier wave."""
         by_shard = {}
         for ud in updates:
             by_shard.setdefault(ud.cluster_id % self._num, []).append(ud)
+        pending = []
         for sid, uds in by_shard.items():
-            self._shards[sid].save_raft_state(uds)
+            kv = self._shards[sid].save_raft_state_deferred(uds)
+            if kv is not None:
+                pending.append(kv)
+        return pending
 
     def read_raft_state(self, cluster_id, node_id, last_index) -> RaftState:
         sh = self._shard(cluster_id)

@@ -12,7 +12,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..core.rate import RateLimiter
 from ..raftio import IMessageHandler, IRaftRPC
@@ -189,6 +189,33 @@ class Transport:
             self._notify_unreachable_one(m.cluster_id, m.to)
             return False
         return self.send_to_address(addr, m)
+
+    def send_many(self, msgs) -> int:
+        """Queue many messages in one pass: resolve and group by target
+        address first, then amortize the breaker check and queue lookup
+        over each target's whole batch (the engine's columnar fan-out
+        emits one such batch per step instead of per-message send()
+        calls). Returns how many messages were queued."""
+        if not msgs:
+            return 0
+        by_addr: Dict[str, List[Message]] = {}
+        for m in msgs:
+            addr = self.nodes.resolve(m.cluster_id, m.to)
+            if addr is None:
+                self._notify_unreachable_one(m.cluster_id, m.to)
+                continue
+            by_addr.setdefault(addr, []).append(m)
+        sent = 0
+        if self._stopped.is_set():
+            return 0
+        for addr, ms in by_addr.items():
+            if not self._get_breaker(addr).ready():
+                continue
+            sq = self._get_queue(addr)
+            for m in ms:
+                if sq.try_put(m):
+                    sent += 1
+        return sent
 
     def send_to_address(self, addr: str, m: Message) -> bool:
         if self._stopped.is_set():

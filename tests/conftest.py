@@ -9,9 +9,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dragonboat_tpu._jaxenv import pin_cpu
+from dragonboat_tpu._jaxenv import enable_compile_cache, pin_cpu
 
 pin_cpu(n_devices=8)
+# warm XLA compiles across pytest processes: the step kernel costs seconds
+# per distinct KernelConfig, and election-deadline tests race exactly that
+# first compile on slow boxes
+enable_compile_cache()
 
 
 def pytest_configure(config):

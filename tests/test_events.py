@@ -148,7 +148,7 @@ def test_nodehost_health_metrics_end_to_end():
             {1: "m1:1"}, False, lambda c, n: KVStateMachine(c, n),
             Config(cluster_id=1, node_id=1, election_rtt=10, heartbeat_rtt=2),
         )
-        deadline = time.time() + 10
+        deadline = time.time() + 40
         while time.time() < deadline:
             lid, ok = nh.get_leader_id(1)
             if ok and lid == 1:

@@ -1,0 +1,149 @@
+"""Static guard over the vector engine's step hot path.
+
+The columnar host fan-out replaced per-(group, peer) Python — per-element
+`int(arr[g, p])` reads, `.item()` calls and `.tolist()` conversions inside
+loops — with whole-column gathers done ONCE per plane outside any loop.
+This lint fails if those patterns creep back into the hot functions, which
+silently reintroduces O(messages) host work per step (the 340x
+kernel-vs-e2e gap this architecture closed).
+
+Rules, applied to each function in HOT_FUNCTIONS (and any loop nested in
+them):
+
+  * no `.tolist()` or `.item()` calls inside a for/while body —
+    column-level `.tolist()` OUTSIDE loops is the fast idiom and stays
+    allowed;
+  * no `int(x[...])` scalar conversions of subscripted values inside a
+    for/while body (a per-element device-mirror read).
+
+Slow paths (catchup, snapshot feedback, reconciles, rebase, `_maintain`)
+are intentionally NOT listed: they run on rare lanes and may use
+per-element access. A genuinely unavoidable exception inside a hot
+function can be whitelisted with a trailing `# hot-path: ok` comment —
+none exist today, so think twice.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+
+import dragonboat_tpu.engine.vector as vector
+
+# the step hot path: every function here runs once per engine step on the
+# loop thread (pack -> dispatch -> fetch -> decode/fan-out -> save)
+HOT_FUNCTIONS = [
+    ("VectorEngine", "_run_once"),
+    ("VectorEngine", "_pack"),
+    ("VectorEngine", "_pack_wire"),
+    ("VectorEngine", "_stage_row"),
+    ("VectorEngine", "_flush_staged_rows"),
+    ("VectorEngine", "_fetch_output"),
+    ("VectorEngine", "_decode"),
+    ("VectorEngine", "_dispatch_sends"),
+    ("VectorEngine", "_save_updates"),
+    ("VectorEngine", "try_local_deliver_many"),
+    (None, "gather_replicate_sends"),
+    (None, "gather_post_sends"),
+    (None, "gather_resp_sends"),
+    (None, "build_save_updates"),
+]
+
+WHITELIST_MARK = "hot-path: ok"
+
+
+def _resolve(cls_name, fn_name):
+    obj = vector if cls_name is None else getattr(vector, cls_name)
+    return getattr(obj, fn_name)
+
+
+def _function_ast(fn):
+    src = inspect.getsource(fn)
+    # dedent for methods
+    import textwrap
+
+    tree = ast.parse(textwrap.dedent(src))
+    node = tree.body[0]
+    assert isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return node, inspect.getsourcelines(fn)
+
+
+def _violations_in(fn_node, src_lines, first_lineno, fn_label):
+    out = []
+
+    def line_of(node):
+        # node.lineno is relative to the dedented source
+        return src_lines[node.lineno - 1]
+
+    def check_loop_body(loop):
+        # only the BODY is hot-per-iteration; the iterator expression runs
+        # once and is exactly where column-level .tolist() belongs
+        for stmt in loop.body + loop.orelse:
+            yield from ast.walk(stmt)
+
+    def check_loop(loop):
+        for sub in check_loop_body(loop):
+            if isinstance(sub, ast.Call):
+                # .tolist() / .item() inside a loop body
+                if isinstance(sub.func, ast.Attribute) and sub.func.attr in (
+                    "tolist",
+                    "item",
+                ):
+                    if WHITELIST_MARK not in line_of(sub):
+                        out.append(
+                            f"{fn_label}:{first_lineno + sub.lineno - 1}: "
+                            f".{sub.func.attr}() inside a hot loop: "
+                            f"{line_of(sub).strip()}"
+                        )
+                # int(x[...]) inside a loop body
+                elif (
+                    isinstance(sub.func, ast.Name)
+                    and sub.func.id == "int"
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Subscript)
+                ):
+                    if WHITELIST_MARK not in line_of(sub):
+                        out.append(
+                            f"{fn_label}:{first_lineno + sub.lineno - 1}: "
+                            f"per-element int(x[...]) inside a hot loop: "
+                            f"{line_of(sub).strip()}"
+                        )
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.For, ast.While)):
+            check_loop(node)
+    return out
+
+
+def test_hot_path_stays_columnar():
+    problems = []
+    for cls_name, fn_name in HOT_FUNCTIONS:
+        label = f"{cls_name + '.' if cls_name else ''}{fn_name}"
+        try:
+            fn = _resolve(cls_name, fn_name)
+        except AttributeError:
+            problems.append(
+                f"{label}: hot function no longer exists — update the "
+                f"HOT_FUNCTIONS list (and keep its replacement columnar)"
+            )
+            continue
+        fn_node, (src_lines, first_lineno) = _function_ast(fn)
+        problems.extend(
+            _violations_in(fn_node, src_lines, first_lineno, label)
+        )
+    assert not problems, "\n".join(problems)
+
+
+def test_lint_catches_regressions():
+    """The lint itself must flag the banned patterns (meta-test: a broken
+    linter silently passing everything is worse than no linter)."""
+    bad_src = (
+        "def f(o, gs):\n"
+        "    for g in gs.tolist():\n"  # iterator tolist: ALLOWED
+        "        x = int(o['term'][g])\n"
+        "        y = o['match'][g].tolist()\n"
+        "        z = o['vote'][g].item()\n"
+    )
+    tree = ast.parse(bad_src)
+    lines = bad_src.split("\n")
+    got = _violations_in(tree.body[0], lines, 1, "f")
+    assert len(got) == 3, got

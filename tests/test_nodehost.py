@@ -105,9 +105,9 @@ def test_single_node_propose_and_read(engine_kind):
         nh.start_cluster({1: "a:1"}, False, KVSM, group_config(100, 1))
         assert wait_for(lambda: nh.get_leader_id(100)[1])
         s = nh.get_noop_session(100)
-        r = nh.sync_propose(s, b"k1=v1", timeout_s=5.0)
+        r = nh.sync_propose(s, b"k1=v1", timeout_s=20.0)
         assert r.value == 1
-        assert nh.sync_read(100, "k1", timeout_s=5.0) == "v1"
+        assert nh.sync_read(100, "k1", timeout_s=20.0) == "v1"
         # a second propose
         r2 = nh.sync_propose(s, b"k2=v2")
         assert r2.value == 2
@@ -124,7 +124,7 @@ def test_three_replicas_replicate(engine_kind):
         for nid, nh in zip(members, nhs):
             nh.start_cluster(members, False, KVSM, group_config(5, nid))
         assert wait_for(
-            lambda: any(nh.get_leader_id(5)[1] for nh in nhs), timeout=15
+            lambda: any(nh.get_leader_id(5)[1] for nh in nhs), timeout=45
         )
         # find leader host
         def leader_nh():
@@ -136,10 +136,10 @@ def test_three_replicas_replicate(engine_kind):
                         return nh
             return None
 
-        assert wait_for(lambda: leader_nh() is not None, timeout=15)
+        assert wait_for(lambda: leader_nh() is not None, timeout=45)
         lnh = leader_nh()
         s = lnh.get_noop_session(5)
-        res = lnh.sync_propose(s, b"x=42", timeout_s=5.0)
+        res = lnh.sync_propose(s, b"x=42", timeout_s=20.0)
         assert res.value == 1
         # all three replicas converge
         assert wait_for(
@@ -210,13 +210,13 @@ def test_membership_change_e2e(engine_kind):
                 {1: "a:1", 2: "b:2"}, False, KVSM, group_config(9, nid)
             )
         assert wait_for(
-            lambda: any(nhs[n].get_leader_id(9)[1] for n in (1, 2)), timeout=15
+            lambda: any(nhs[n].get_leader_id(9)[1] for n in (1, 2)), timeout=45
         )
         lid = next(
             nhs[n].get_leader_id(9)[0] for n in (1, 2) if nhs[n].get_leader_id(9)[1]
         )
         lnh = nhs[lid]
-        lnh.sync_request_add_node(9, 3, "c:3", timeout_s=8.0)
+        lnh.sync_request_add_node(9, 3, "c:3", timeout_s=25.0)
         m = lnh.get_cluster_membership(9)
         assert m.addresses.get(3) == "c:3"
         # node 3 joins
@@ -228,10 +228,10 @@ def test_membership_change_e2e(engine_kind):
                 1 for sm in KVSM.instances if sm.data.get("after") == "join"
             )
             == 3,
-            timeout=15,
+            timeout=45,
         )
         # remove node 3 again
-        lnh.sync_request_delete_node(9, 3, timeout_s=8.0)
+        lnh.sync_request_delete_node(9, 3, timeout_s=25.0)
         m2 = lnh.get_cluster_membership(9)
         assert 3 not in m2.addresses
     finally:
@@ -256,9 +256,9 @@ def test_restart_replay(tmp_path, engine_kind):
     nh2 = mk_nodehost("a:1", reg2, nodehost_dir=d, engine_kind=engine_kind)
     try:
         nh2.start_cluster({1: "a:1"}, False, KVSM, group_config(3, 1))
-        assert wait_for(lambda: nh2.get_leader_id(3)[1], timeout=15)
+        assert wait_for(lambda: nh2.get_leader_id(3)[1], timeout=45)
         assert wait_for(
-            lambda: nh2.stale_read(3, "k4") == "4", timeout=10
+            lambda: nh2.stale_read(3, "k4") == "4", timeout=30
         )
     finally:
         nh2.stop()
@@ -278,11 +278,11 @@ def test_leader_transfer(engine_kind):
                     return nid
             return None
 
-        assert wait_for(lambda: current_leader() is not None, timeout=15)
+        assert wait_for(lambda: current_leader() is not None, timeout=45)
         old = current_leader()
         target = next(n for n in (1, 2, 3) if n != old)
         nhs[old].request_leader_transfer(11, target)
-        assert wait_for(lambda: current_leader() == target, timeout=15)
+        assert wait_for(lambda: current_leader() == target, timeout=45)
     finally:
         for nh in nhs.values():
             nh.stop()

@@ -85,12 +85,12 @@ def test_import_snapshot_quorum_repair(tmp_path):
     leader = _wait_leader(hosts)
     s = hosts[leader].get_noop_session(CLUSTER)
     for i in range(10):
-        hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), timeout_s=15.0)
+        hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), timeout_s=45.0)
 
     export_root = str(tmp_path / "export")
     os.makedirs(export_root)
     hosts[leader].sync_request_snapshot(
-        CLUSTER, export_path=export_root, timeout_s=10.0
+        CLUSTER, export_path=export_root, timeout_s=30.0
     )
     exported = [
         os.path.join(export_root, d) for d in os.listdir(export_root)
@@ -116,7 +116,7 @@ def test_import_snapshot_quorum_repair(tmp_path):
         Config(cluster_id=CLUSTER, node_id=1,
                election_rtt=20, heartbeat_rtt=4),
     )
-    deadline = time.time() + 20
+    deadline = time.time() + 60
     while time.time() < deadline:
         lid, ok = nh1.get_leader_id(CLUSTER)
         if ok and lid == 1:
@@ -124,13 +124,13 @@ def test_import_snapshot_quorum_repair(tmp_path):
         time.sleep(0.02)
     else:
         raise AssertionError("survivor never became single-node leader")
-    assert nh1.sync_read(CLUSTER, "k9", timeout_s=10.0) == "v9"
+    assert nh1.sync_read(CLUSTER, "k9", timeout_s=30.0) == "v9"
     m = nh1.get_cluster_membership(CLUSTER)
     assert set(m.addresses) == {1}
     # and it can still make progress
     s = nh1.get_noop_session(CLUSTER)
-    nh1.sync_propose(s, b"post=repair", timeout_s=10.0)
-    assert nh1.sync_read(CLUSTER, "post", timeout_s=10.0) == "repair"
+    nh1.sync_propose(s, b"post=repair", timeout_s=30.0)
+    assert nh1.sync_read(CLUSTER, "post", timeout_s=30.0) == "repair"
     nh1.stop()
 
 
@@ -167,7 +167,7 @@ def test_export_does_not_compact_own_history(tmp_path):
         nh.sync_propose(s, f"e{i}=x{i}".encode(), timeout_s=5.0)
     exp = tmp_path / "exp"
     exp.mkdir()
-    nh.sync_request_snapshot(CLUSTER, export_path=str(exp), timeout_s=10.0)
+    nh.sync_request_snapshot(CLUSTER, export_path=str(exp), timeout_s=30.0)
     nh.stop()
 
     nh2 = NodeHost(_nh_config(1, str(tmp_path), reg))
@@ -176,7 +176,7 @@ def test_export_does_not_compact_own_history(tmp_path):
         Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
                heartbeat_rtt=2, compaction_overhead=3),
     )
-    deadline = time.time() + 20
+    deadline = time.time() + 60
     while time.time() < deadline:
         try:
             if nh2.stale_read(CLUSTER, "e19") == "x19":

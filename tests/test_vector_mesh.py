@@ -93,7 +93,7 @@ def test_sharded_engine_three_replicas_commit():
         from dragonboat_tpu.requests import RequestError
 
         for c in range(1, groups + 1):
-            for attempt in range(4):
+            for attempt in range(6):
                 lid, ok = hosts[1].get_leader_id(c)
                 try:
                     if not ok or lid not in hosts:
@@ -102,9 +102,9 @@ def test_sharded_engine_three_replicas_commit():
                     hosts[lid].sync_propose(s, f"g{c}=v{c}".encode(), 30.0)
                     break
                 except RequestError:
-                    if attempt == 3:
+                    if attempt == 5:
                         raise
-                    time.sleep(0.5)
+                    time.sleep(1.0)
         # linearizable read-back on a follower host for a few groups
         for c in (1, groups // 2, groups):
             lid = hosts[1].get_leader_id(c)[0]
@@ -113,7 +113,7 @@ def test_sharded_engine_three_replicas_commit():
                 lambda c=c, fid=fid: hosts[fid].sync_read(
                     c, f"g{c}", timeout_s=10.0
                 ) == f"v{c}",
-                timeout=20,
+                timeout=60,
             )
     finally:
         for nh in hosts.values():
