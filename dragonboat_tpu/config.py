@@ -114,6 +114,23 @@ class EngineConfig:
     # per stage only on sampled iterations), 1 = record every step (full
     # stage timings; benches and debugging), N>1 = sample 1/N.
     profile_sample_ratio: int = 0
+    # Per-step cap on coalesced tick backlogs after an engine loop stall
+    # (cold compile, CPU contention between co-scheduled loops). Backlog
+    # beyond the cap is SHED, not deferred: a stall compresses into at
+    # most this many logical ticks per step and the rest of the wall-
+    # clock time is simply not charged to timers — which is what keeps
+    # the randomized election-timer spread intact (the old election-RTT
+    # cap charged a whole election timeout in one step, synchronizing
+    # every follower's timeout into split-vote storms). Tick-denominated
+    # timeouts therefore stretch across stalls by design. 0 = auto: each
+    # lane's heartbeat RTT; never exceeds a lane's election RTT.
+    max_catchup_ticks: int = 0
+    # Tick-fairness watchdog yield threshold in milliseconds: an engine
+    # loop iteration longer than this yields the CPU to co-scheduled peer
+    # loops it starved (see engine/fairness.py). None = auto
+    # (max(4 tick periods, 20ms)); 0 disables enforcement (the starvation
+    # gauge keeps measuring either way).
+    fairness_yield_ms: "Optional[float]" = None
     # Co-hosted engine sharing: NodeHosts in one process constructed with
     # the same non-None scope string share ONE VectorEngine device state, so
     # all their replicas advance in a single kernel step and messages

@@ -22,6 +22,7 @@ from ..settings import hard, soft
 from ..trace import Profiler
 from ..types import Update
 from ..rsm.manager import From as OffloadFrom
+from .fairness import FairnessWatchdog
 from .node import Node
 
 _plog = get_logger("execengine")
@@ -98,8 +99,26 @@ class ExecEngine:
         num_task_workers: Optional[int] = None,
         num_snapshot_workers: int = 4,
         sample_ratio: Optional[int] = None,
+        tick_period_s: float = 0.05,
+        fairness_yield_ms: Optional[float] = None,
     ) -> None:
         self._logdb = logdb
+        # tick-fairness watchdog (see engine/fairness.py): worker 0 is the
+        # engine's heartbeat — it wakes at least once per tick period, so
+        # an idle healthy engine reads starvation_ratio ~1.0 (same scale
+        # as the vector loop) and a stale beat means this engine is being
+        # starved of CPU by a co-scheduled peer loop (or is itself
+        # starving them). fairness_yield_ms follows the EngineConfig
+        # contract: None = auto threshold, 0 disables enforcement.
+        self.watchdog = FairnessWatchdog(
+            "exec-step",
+            tick_period_s,
+            yield_threshold_s=(
+                float("inf") if fairness_yield_ms == 0
+                else (fairness_yield_ms / 1000.0 if fairness_yield_ms else None)
+            ),
+        )
+        self._wd_wait = min(0.5, max(tick_period_s, 1e-3))
         # Python threads contend on the GIL: default pools are smaller than
         # the Go engine's 16; protocol work is lock-striped the same way
         self._n_step = num_step_workers or min(hard.step_engine_worker_count, 8)
@@ -172,9 +191,14 @@ class ExecEngine:
 
     # ---------------------------------------------------------- step workers
     def _node_worker_main(self, worker: int) -> None:
+        wd = self.watchdog if worker == 0 else None
         while not self._stopped.is_set():
-            cids = self.node_ready.wait_and_take(worker)
+            cids = self.node_ready.wait_and_take(
+                worker, self._wd_wait if wd is not None else 0.5
+            )
             if not cids:
+                if wd is not None:  # heartbeat: records the idle gap only
+                    wd.iter_end(wd.iter_begin())
                 continue
             nodes = []
             with self._nodes_mu:
@@ -183,12 +207,15 @@ class ExecEngine:
                     if n is not None and not n.stopped:
                         nodes.append(n)
             if nodes:
+                t0 = wd.iter_begin() if wd is not None else 0.0
                 try:
                     self.exec_nodes(nodes, worker)
                 except Exception:  # a group failure must not kill the worker
                     import traceback
 
                     traceback.print_exc()
+                if wd is not None:
+                    wd.iter_end(t0)
 
     def exec_nodes(self, nodes: List[Node], worker: int = 0) -> None:
         """THE hot loop (cf. execNodes execengine.go:474-560)."""
@@ -282,7 +309,12 @@ class ExecEngine:
                     node.sm.offloaded(OffloadFrom.SNAPSHOT_WORKER)
 
     # --------------------------------------------------------------- control
+    def fairness_stats(self) -> dict:
+        """Tick-fairness watchdog snapshot (see engine/fairness.py)."""
+        return self.watchdog.stats()
+
     def stop(self) -> None:
+        self.watchdog.close()
         self._stopped.set()
         self.node_ready.wake_all()
         self.task_ready.wake_all()

@@ -1,0 +1,176 @@
+"""Tick-fairness watchdog for co-scheduled engine loops.
+
+Several engine loops commonly share one box: three NodeHosts in one test
+process, or co-hosted replicas pinned to a small CPU host driving an
+accelerator. When one loop's kernel step runs for multiple tick periods
+(cold XLA compile, CPU contention), its peers' loop threads starve: their
+tick backlogs balloon and, once they finally run, the burst replay used to
+advance election timers by a whole election RTT in a single step —
+synchronizing every follower's timeout into split-vote storms (the ROADMAP
+seed flake; cf. the Podracer line of work on co-scheduled accelerator
+loops, arXiv:2104.06272, which makes loop fairness a first-class concern).
+
+The watchdog gives every engine loop three things:
+
+  1. measurement — per-loop inter-iteration latency against the expected
+     tick period, kept as a windowed maximum so a single stall stays
+     visible for a while after it happens;
+  2. a starvation gauge — `starvation_ratio` = recent max gap / tick
+     period (1.0 = keeping up; 100 = a stall of 100 tick periods), which
+     NodeHost exports through its MetricsRegistry;
+  3. enforcement — after an iteration that overran the yield threshold
+     while some co-scheduled peer loop made no progress, the loop cedes
+     the CPU with a short sleep so the starved peer's thread gets a
+     scheduling slice before the next kernel step is dispatched.
+
+Watchdogs register in a process-global peer table; peers are discovered
+automatically, so tests with three NodeHosts get fairness between their
+three engine loops with zero configuration.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+_peers_mu = threading.Lock()
+_peers: List["FairnessWatchdog"] = []
+
+
+def _register(wd: "FairnessWatchdog") -> None:
+    with _peers_mu:
+        _peers.append(wd)
+
+
+def _unregister(wd: "FairnessWatchdog") -> None:
+    with _peers_mu:
+        try:
+            _peers.remove(wd)
+        except ValueError:
+            pass
+
+
+def peer_count() -> int:
+    with _peers_mu:
+        return len(_peers)
+
+
+class FairnessWatchdog:
+    """Per-engine-loop fairness monitor; see module docstring.
+
+    All hot-path methods (`iter_begin`/`iter_end`/`tick_burst`) run on the
+    owning loop thread only and touch plain attributes — no locks beyond a
+    snapshot read of the peer list. `stats()` may be called from any
+    thread; it reads torn-safe scalars.
+    """
+
+    # gap window: how long a stall stays visible in the gauge (iterations)
+    _WINDOW = 256
+
+    def __init__(
+        self,
+        name: str,
+        tick_period_s: float,
+        yield_threshold_s: Optional[float] = None,
+        yield_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.tick_period_s = max(tick_period_s, 1e-4)
+        # auto: an iteration 4+ tick periods long is starving its peers
+        self.yield_threshold_s = (
+            yield_threshold_s
+            if yield_threshold_s is not None
+            else max(4 * self.tick_period_s, 0.02)
+        )
+        self._yield_s = yield_s
+        self._clock = clock
+        self._last_end = clock()
+        self._max_gap_s = 0.0  # lifetime max
+        self._recent_max_s = 0.0  # windowed max
+        self._recent_left = self._WINDOW
+        self._iters = 0
+        self._yields = 0
+        self._tick_burst_max = 0
+        self._tick_bursts_clamped = 0
+        self._closed = False
+        _register(self)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _unregister(self)
+
+    # ------------------------------------------------------------ hot path
+    def iter_begin(self) -> float:
+        return self._clock()
+
+    def iter_end(self, t0: float, ticks: int = 0) -> bool:
+        """Record one loop iteration; returns True when a fairness yield
+        was enforced (the loop slept to cede CPU to a starved peer)."""
+        now = self._clock()
+        gap = now - self._last_end
+        self._last_end = now
+        self._iters += 1
+        if gap > self._max_gap_s:
+            self._max_gap_s = gap
+        if gap >= self._recent_max_s:
+            self._recent_max_s = gap
+            self._recent_left = self._WINDOW
+        else:
+            self._recent_left -= 1
+            if self._recent_left <= 0:
+                self._recent_max_s = gap
+                self._recent_left = self._WINDOW
+        if ticks > self._tick_burst_max:
+            self._tick_burst_max = ticks
+        dur = now - t0
+        if dur < self.yield_threshold_s:
+            return False
+        if not self._peer_starved(t0):
+            return False
+        self._yields += 1
+        # cede proportionally to how long we hogged the core, bounded so a
+        # pathological multi-second step never parks the loop for long
+        pause = self._yield_s or min(0.02, max(0.001, dur * 0.05))
+        time.sleep(pause)
+        return True
+
+    def tick_burst_clamped(self) -> None:
+        """A coalesced tick backlog exceeded the per-step replay clamp."""
+        self._tick_bursts_clamped += 1
+
+    # a peer whose beat is older than this is abandoned (an engine that
+    # was never stop()ed), not starved: yielding to it helps nobody and
+    # a single leaked watchdog must not slow every other loop forever
+    _STALE_PEER_S = 60.0
+
+    def _peer_starved(self, since: float) -> bool:
+        with _peers_mu:
+            peers = list(_peers)
+        for p in peers:
+            if p is self or p._closed:
+                continue
+            if since - p._last_end > self._STALE_PEER_S:
+                continue  # abandoned, not starved
+            if p._last_end < since:
+                return True
+        return False
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "tick_period_s": self.tick_period_s,
+            "max_gap_s": self._max_gap_s,
+            "recent_max_gap_s": self._recent_max_s,
+            "starvation_ratio": self._recent_max_s / self.tick_period_s,
+            "tick_burst_max": self._tick_burst_max,
+            "tick_bursts_clamped": self._tick_bursts_clamped,
+            "fairness_yields": self._yields,
+            "iterations": self._iters,
+            "co_scheduled_peers": peer_count() - (0 if self._closed else 1),
+        }
+
+
+__all__ = ["FairnessWatchdog", "peer_count"]

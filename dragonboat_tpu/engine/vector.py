@@ -116,6 +116,7 @@ from ..types import (
 )
 from ..rsm.manager import From as OffloadFrom
 from .execengine import WorkReady
+from .fairness import FairnessWatchdog
 from .node import Node
 
 _plog = get_logger("vectorengine")
@@ -888,6 +889,34 @@ class VectorEngine:
         # EngineConfig.profile_sample_ratio=1.
         ratio = (getattr(ecfg, "profile_sample_ratio", 0) or 0) if ecfg else 0
         self.profiler = Profiler(sample_ratio=ratio if ratio > 0 else 32)
+        # ---- tick-fairness watchdog (ROADMAP seed flake) -----------------
+        # Inter-iteration latency vs the host's tick period, a starvation
+        # gauge, and an enforced yield when a long kernel step starved a
+        # co-scheduled peer loop (see engine/fairness.py).
+        tick_s = (
+            (nh_config.rtt_millisecond or 50) / 1000.0
+            if nh_config is not None
+            else 0.05
+        )
+        yield_ms = getattr(ecfg, "fairness_yield_ms", None) if ecfg else None
+        self.watchdog = FairnessWatchdog(
+            "vec-step",
+            tick_s,
+            # 0 disables enforcement (measurement stays on); None = auto
+            yield_threshold_s=(
+                float("inf") if yield_ms == 0
+                else (yield_ms / 1000.0 if yield_ms else None)
+            ),
+        )
+        # per-step replay clamp for coalesced tick backlogs: replaying a
+        # stall's whole backlog at election-RTT granularity expires every
+        # follower's randomized timer in the same step (synchronized
+        # split-vote storms after any multi-second stall — the seed
+        # flake); 0 = auto: clamp at each lane's heartbeat RTT
+        self._catchup_tick_cap = (
+            getattr(ecfg, "max_catchup_ticks", 0) or 0 if ecfg else 0
+        )
+        self._last_tick_burst = 0
         self._step_fn = make_step_fn(self.kcfg, donate=True)
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
@@ -1201,17 +1230,21 @@ class VectorEngine:
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
         period = 0.002
+        wd = self.watchdog
         while not self._stopped.is_set():
             self._ready.wait(period)
             self._ready.clear()
             if self._stopped.is_set():
                 break
+            t0 = wd.iter_begin()
+            self._last_tick_burst = 0
             try:
                 self._run_once()
             except Exception:
                 import traceback
 
                 traceback.print_exc()
+            wd.iter_end(t0, ticks=self._last_tick_burst)
         try:
             self._flush_pending()  # the last step's saves must land
         except Exception:
@@ -1295,7 +1328,10 @@ class VectorEngine:
         if ticks:
             # per-lane tick counts come from the OWNING host's counter (a
             # shared core serves several NodeHosts, each with its own tick
-            # thread); capped per lane at its election RTT
+            # thread); clamped per lane at its catch-up burst cap, and the
+            # EXCESS backlog is shed — not deferred — so a stall charges
+            # at most one small burst to each timer and the randomized
+            # election spread survives (see _catchup_tick_cap)
             if self._next_host <= 1:
                 per_lane = ticks
             else:
@@ -1305,6 +1341,14 @@ class VectorEngine:
                 per_lane = hv[self._m_host]
             np.minimum(self._m_tick_cap, per_lane, out=self._ticks)
             self._ticks *= self._m_active
+            self._last_tick_burst = ticks
+            if ticks > 1 and bool(
+                np.any((per_lane > self._m_tick_cap) & self._m_active)
+            ):
+                # some ACTIVE lane's own host backlog exceeded its cap
+                # (per_lane broadcasts: scalar for a single host, the
+                # owning host's column otherwise)
+                self.watchdog.tick_burst_clamped()
         else:
             self._ticks.fill(0)
         # ONE device_put over the (inbox, ticks) pytree: 12 small host
@@ -2644,7 +2688,17 @@ class VectorEngine:
         self._m_leader[g] = 0
         self._m_commit[g] = committed - b
         self._m_last[g] = dev_last
-        self._m_tick_cap[g] = max(cfg.election_rtt, 1)
+        # catch-up burst cap: at most this many coalesced ticks apply in
+        # one kernel step; the rest of a stall's backlog is shed. The old
+        # cap (election RTT) let a single post-stall step add
+        # `election_rtt` ticks — every follower lane crossed rand_timeout
+        # ∈ [et, 2et) within two steps simultaneously, collapsing the
+        # randomized election spread into synchronized split-vote storms
+        # (the ROADMAP seed flake). Capping at the heartbeat RTT keeps
+        # timers advancing while a live leader's next heartbeat can still
+        # land between bursts.
+        burst = self._catchup_tick_cap or hb
+        self._m_tick_cap[g] = max(1, min(cfg.election_rtt, burst))
         self._m_active[g] = True
         self._m_snap_every[g] = cfg.snapshot_entries
         self._m_applied_since[g] = 0
@@ -3038,6 +3092,11 @@ class VectorEngine:
     def profile_summary(self) -> dict:
         return self.profiler.summary()
 
+    def fairness_stats(self) -> dict:
+        """Tick-fairness watchdog snapshot: inter-iteration latency vs the
+        tick period, the starvation gauge, burst clamps, enforced yields."""
+        return self.watchdog.stats()
+
     def leader_snapshot(self) -> Dict[tuple, Tuple[int, int]]:
         """One vectorized pass over the numpy mirrors: lane key ->
         (leader_node_id, term) for every active lane. Replaces per-group
@@ -3097,6 +3156,7 @@ class VectorEngine:
         rep = self.profiler.report()
         if rep:
             _plog.infof("vector engine stage profile:\n%s", rep)
+        self.watchdog.close()
         self._stopped.set()
         self._ready.set()
         self.task_ready.wake_all()

@@ -1,0 +1,404 @@
+"""FaultPlane: deterministic, seeded fault injection as a first-class
+subsystem.
+
+The reference dragonboat validates itself with monkey tests (docs/
+test.md:11-33): kill, partition, drop and corrupt while client traffic
+runs, then assert linearizability + replica convergence. Here that
+methodology is a library citizen instead of ad-hoc lambdas monkeypatched
+into tests: ONE seed derives every fault decision, so any chaos failure
+replays from the CI log by re-running with the printed seed.
+
+Determinism model
+-----------------
+Every injection site (a named stream: "wire:h1", "fsync:h2/shard-3",
+"faultloop", ...) owns an independent PRNG seeded from (plane seed, site
+name). Decisions are drawn in per-site arrival order, so a site that is
+only touched from one thread (per-target transport workers, the engine
+loop, the orchestration loop) produces a bit-identical verdict sequence
+on replay. Each decision is appended to a bounded schedule log;
+`schedule_signature()` hashes it so tests can assert two same-seeded runs
+produced identical schedules.
+
+Seams composed (all pre-existing, none test-private):
+
+  * transport wire path — `Transport.set_pre_send_batch_hook`: the hook
+    mutates the batch in place (per-message drop/duplicate/reorder) and
+    sleeps for delay faults on the per-target worker thread;
+  * co-hosted delivery — `VectorEngine.set_local_drop_hook` for traffic
+    that short-circuits the wire inside a shared core;
+  * partitions — `NodeHost.set_partitioned` driven from the seeded
+    orchestration stream (`partition_schedule`);
+  * storage — `wrap_kv` / `kv_factory` wrap `IKVStore.sync`/commit with
+    fsync-stall and fsync-error injection; `tear_wal_tail` simulates the
+    torn-tail crash write.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .storage.kv import IKVStore, WriteBatch
+from .types import Message, MessageBatch, MessageType
+
+
+@dataclass
+class FaultSpec:
+    """Per-message / per-sync fault probabilities. All default to off."""
+
+    drop: float = 0.0  # P(message dropped)
+    duplicate: float = 0.0  # P(message duplicated in-batch)
+    reorder: float = 0.0  # P(message held back and re-injected later)
+    reorder_hold: int = 2  # batches a reordered message is held for
+    delay: float = 0.0  # P(batch delayed on the worker thread)
+    delay_s: Tuple[float, float] = (0.001, 0.02)
+    fsync_stall: float = 0.0  # P(sync stalls)
+    fsync_stall_s: Tuple[float, float] = (0.002, 0.02)
+    fsync_error: float = 0.0  # P(sync raises IOError)
+    # restrict wire faults to these types (None = all); lets a schedule
+    # target e.g. replication only while heartbeats flow
+    only_types: Optional[frozenset] = None
+
+    def wire_active(self) -> bool:
+        return bool(self.drop or self.duplicate or self.reorder or self.delay)
+
+
+class _Stream:
+    """One deterministic decision stream: seeded RNG + decision counter."""
+
+    __slots__ = ("rng", "n", "mu")
+
+    def __init__(self, plane_seed: int, site: str) -> None:
+        digest = hashlib.sha256(
+            f"{plane_seed}:{site}".encode()
+        ).digest()
+        self.rng = random.Random(int.from_bytes(digest[:8], "big"))
+        self.n = 0
+        self.mu = threading.Lock()
+
+
+class FaultPlane:
+    """Deterministic fault scheduler; see module docstring.
+
+    `install(nh, site)` arms the wire seams of one NodeHost;
+    `partition_schedule` drives partitions from the seeded orchestration
+    stream; `wrap_kv`/`kv_factory` cover storage. The spec can be swapped
+    live (`set_spec`) to open/close fault windows mid-run."""
+
+    def __init__(
+        self,
+        seed: int,
+        spec: Optional[FaultSpec] = None,
+        record_schedule: bool = True,
+        max_log: int = 200_000,
+    ) -> None:
+        self.seed = seed
+        self.spec = spec or FaultSpec()
+        self._streams: Dict[str, _Stream] = {}
+        self._streams_mu = threading.Lock()
+        self._log: List[tuple] = []
+        self._log_mu = threading.Lock()
+        self._record = record_schedule
+        self._max_log = max_log
+        self._installed: List[tuple] = []  # (kind, target) for uninstall
+        # reorder holding pens: site -> list of (release_at_batch, Message)
+        self._held: Dict[str, list] = {}
+        self._batch_no: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- streams
+    def _stream(self, site: str) -> _Stream:
+        s = self._streams.get(site)
+        if s is None:
+            with self._streams_mu:
+                s = self._streams.setdefault(site, _Stream(self.seed, site))
+        return s
+
+    def decide(self, site: str, kind: str, p: float) -> bool:
+        """Draw one fault decision on `site`'s stream; logged for replay
+        verification."""
+        if p <= 0.0:
+            return False
+        s = self._stream(site)
+        with s.mu:
+            n = s.n
+            s.n += 1
+            verdict = s.rng.random() < p
+        self._log_decision(site, kind, n, verdict)
+        return verdict
+
+    def uniform(self, site: str, kind: str, lo: float, hi: float) -> float:
+        s = self._stream(site)
+        with s.mu:
+            n = s.n
+            s.n += 1
+            v = lo + (hi - lo) * s.rng.random()
+        self._log_decision(site, kind, n, round(v, 9))
+        return v
+
+    def choice(self, site: str, kind: str, options):
+        """Seeded choice for orchestration loops (fault kind, victim)."""
+        s = self._stream(site)
+        with s.mu:
+            n = s.n
+            s.n += 1
+            v = options[int(s.rng.random() * len(options)) % len(options)]
+        self._log_decision(site, kind, n, v)
+        return v
+
+    def _log_decision(self, site, kind, n, verdict) -> None:
+        if not self._record:
+            return
+        with self._log_mu:
+            if len(self._log) < self._max_log:
+                self._log.append((site, kind, n, verdict))
+
+    def schedule_log(self) -> List[tuple]:
+        with self._log_mu:
+            return list(self._log)
+
+    def schedule_signature(self) -> str:
+        """Stable digest of the schedule, ORDER-INSENSITIVE across sites
+        (thread interleaving between sites is not deterministic; the
+        per-site sequence is)."""
+        with self._log_mu:
+            lines = sorted(repr(e) for e in self._log)
+        h = hashlib.sha256()
+        for ln in lines:
+            h.update(ln.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def set_spec(self, spec: FaultSpec) -> None:
+        """Swap the live fault probabilities (open/close a fault window).
+        Streams and their positions are preserved, so a window change does
+        not desynchronize replay."""
+        self.spec = spec
+
+    # -------------------------------------------------------- wire faults
+    def batch_hook(self, site: str) -> Callable[[MessageBatch], bool]:
+        """Pre-send hook for `Transport.set_pre_send_batch_hook`: applies
+        per-message drop/duplicate/reorder by mutating batch.requests and
+        per-batch delay by sleeping on the (per-target) worker thread.
+        Returns False when the whole batch should drop."""
+
+        def hook(batch: MessageBatch) -> bool:
+            # one Transport runs one worker thread PER TARGET address, and
+            # all of them share this hook: sub-key the stream and the
+            # reorder pen by the (stable) worker thread name so each
+            # stream stays single-threaded — the determinism contract —
+            # and the pen is never mutated concurrently
+            site_t = f"{site}#{threading.current_thread().name}"
+            spec = self.spec
+            held = self._held.setdefault(site_t, [])
+            active = spec.wire_active()
+            if not active and not held:
+                return True
+            bno = self._batch_no.get(site_t, 0) + 1
+            self._batch_no[site_t] = bno
+            out: List[Message] = []
+            # release previously held (reordered) messages first: they
+            # jump the queue relative to their original position. The pen
+            # drains even after the fault window closes — a held message
+            # must never be silently leaked.
+            if held:
+                due = [m for rel, m in held if rel <= bno or not active]
+                held[:] = [] if not active else [
+                    (rel, m) for rel, m in held if rel > bno
+                ]
+                out.extend(due)
+            if not active:
+                out.extend(batch.requests)
+                batch.requests[:] = out
+                return True
+            for m in batch.requests:
+                targeted = spec.only_types is None or m.type in spec.only_types
+                if targeted and self.decide(site_t, "drop", spec.drop):
+                    continue
+                if targeted and self.decide(site_t, "reorder", spec.reorder):
+                    held.append((bno + spec.reorder_hold, m))
+                    continue
+                out.append(m)
+                if targeted and self.decide(site_t, "dup", spec.duplicate):
+                    out.append(m)
+            batch.requests[:] = out
+            if spec.delay and self.decide(site_t, "delay", spec.delay):
+                time.sleep(
+                    self.uniform(site_t, "delay_s", *spec.delay_s)
+                )
+            return bool(batch.requests)
+
+        return hook
+
+    def message_hook(self, site: str) -> Callable[[Message], bool]:
+        """Drop predicate for co-hosted delivery
+        (`VectorEngine.set_local_drop_hook`): True = drop. Duplicate/
+        reorder/delay do not apply on the in-core path — it models a
+        shared-memory exchange, not a lossy wire."""
+
+        def hook(m: Message) -> bool:
+            spec = self.spec
+            if not spec.drop:
+                return False
+            if spec.only_types is not None and m.type not in spec.only_types:
+                return False
+            return self.decide(site, "local_drop", spec.drop)
+
+        return hook
+
+    def install(self, nh, site: str) -> None:
+        """Arm one NodeHost's wire seams: the transport pre-send hook and,
+        when its engine is a (possibly shared) vector core, the co-hosted
+        delivery drop hook."""
+        nh.transport.set_pre_send_batch_hook(self.batch_hook(f"wire:{site}"))
+        core = getattr(nh.engine, "core", None) or nh.engine
+        set_local = getattr(core, "set_local_drop_hook", None)
+        if set_local is not None:
+            set_local(self.message_hook(f"local:{site}"))
+            self._installed.append(("local", core))
+        self._installed.append(("wire", nh.transport))
+
+    def uninstall(self, nh) -> None:
+        """Disarm one NodeHost's wire seams (the windowed-fault path: arm
+        the victim, sleep the window, disarm)."""
+        nh.transport.set_pre_send_batch_hook(None)
+        core = getattr(nh.engine, "core", None) or nh.engine
+        set_local = getattr(core, "set_local_drop_hook", None)
+        if set_local is not None:
+            set_local(None)
+        self._installed = [
+            (k, t)
+            for k, t in self._installed
+            if t is not nh.transport and t is not core
+        ]
+
+    def uninstall_all(self) -> None:
+        for kind, target in self._installed:
+            try:
+                if kind == "wire":
+                    target.set_pre_send_batch_hook(None)
+                else:
+                    target.set_local_drop_hook(None)
+            except Exception:
+                pass
+        self._installed.clear()
+
+    # -------------------------------------------------------- partitions
+    def partition_schedule(
+        self,
+        site: str,
+        victims,
+        total_s: float,
+        min_window_s: float = 0.3,
+        max_window_s: float = 0.8,
+    ):
+        """Yield a seeded sequence of (victim, heal_after_s, idle_s)
+        partition windows covering ~total_s seconds. The caller applies
+        them (`nh.set_partitioned(True)`, sleep, heal, sleep) so restarts
+        and other orchestration can interleave."""
+        budget = total_s
+        victims = list(victims)
+        while budget > 0:
+            victim = self.choice(site, "victim", victims)
+            window = self.uniform(site, "window", min_window_s, max_window_s)
+            idle = self.uniform(site, "idle", 0.1, 0.4)
+            yield victim, window, idle
+            budget -= window + idle
+
+    # ----------------------------------------------------- storage faults
+    def wrap_kv(self, kv: IKVStore, site: str) -> "FaultyKV":
+        return FaultyKV(kv, self, site)
+
+    def kv_factory(
+        self, site: str, base_factory: Callable[[str], IKVStore]
+    ) -> Callable[[str], IKVStore]:
+        """Factory adapter for ShardedLogDB(kv_factory=...): every shard's
+        store is wrapped with fsync fault injection on its own stream."""
+
+        def make(dirname: str) -> IKVStore:
+            shard = os.path.basename(dirname) if dirname else "mem"
+            return self.wrap_kv(base_factory(dirname), f"{site}/{shard}")
+
+        return make
+
+    def maybe_fsync_fault(self, site: str) -> None:
+        """The injection point FaultyKV runs before a durability barrier."""
+        spec = self.spec
+        if spec.fsync_stall and self.decide(site, "fsync_stall", spec.fsync_stall):
+            time.sleep(self.uniform(site, "fsync_stall_s", *spec.fsync_stall_s))
+        if spec.fsync_error and self.decide(site, "fsync_error", spec.fsync_error):
+            raise IOError(f"FaultPlane(seed={self.seed}): injected fsync error")
+
+    def tear_wal_tail(self, wal_dir: str, site: str) -> int:
+        """Simulate a torn tail write: chop a seeded number of bytes off
+        the WAL's end (the store must be closed). Returns bytes removed;
+        recovery must roll back to the last sealed record group."""
+        path = os.path.join(wal_dir, "wal.log")
+        size = os.path.getsize(path) if os.path.exists(path) else 0
+        if size == 0:
+            return 0
+        cut = 1 + int(self.uniform(site, "tear", 0, min(size - 1, 64)))
+        with open(path, "ab") as f:
+            f.truncate(size - cut)
+        return cut
+
+
+class FaultyKV(IKVStore):
+    """Delegating IKVStore wrapper that injects fsync stalls/errors at the
+    durability barriers (commit_write_batch's implicit barrier and the
+    group-commit sync())."""
+
+    def __init__(self, inner: IKVStore, plane: FaultPlane, site: str) -> None:
+        self.inner = inner
+        self.plane = plane
+        self.site = site
+
+    def name(self) -> str:
+        return f"faulty-{self.inner.name()}"
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def get_value(self, key):
+        return self.inner.get_value(key)
+
+    def iterate_value(self, fk, lk, inc_last, op) -> None:
+        self.inner.iterate_value(fk, lk, inc_last, op)
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        self.plane.maybe_fsync_fault(self.site)
+        self.inner.commit_write_batch(wb)
+
+    def commit_write_batch_deferred(self, wb: WriteBatch) -> bool:
+        return self.inner.commit_write_batch_deferred(wb)
+
+    def sync(self) -> None:
+        self.plane.maybe_fsync_fault(self.site)
+        self.inner.sync()
+
+    def bulk_remove_entries(self, fk, lk) -> None:
+        self.inner.bulk_remove_entries(fk, lk)
+
+    def compact_entries(self, fk, lk) -> None:
+        self.inner.compact_entries(fk, lk)
+
+    def full_compaction(self) -> None:
+        self.inner.full_compaction()
+
+
+# message classes a chaos schedule usually wants to target (bulk data
+# plane) while the control plane keeps flowing
+REPLICATION_TYPES = frozenset(
+    {MessageType.REPLICATE, MessageType.REPLICATE_RESP}
+)
+
+
+__all__ = [
+    "FaultPlane",
+    "FaultSpec",
+    "FaultyKV",
+    "REPLICATION_TYPES",
+]

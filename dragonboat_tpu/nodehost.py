@@ -177,7 +177,13 @@ class NodeHost(IMessageHandler):
 
             self.engine = get_vector_engine(self.logdb, cfg)
         else:
-            self.engine = ExecEngine(self.logdb)
+            self.engine = ExecEngine(
+                self.logdb,
+                tick_period_s=cfg.rtt_millisecond / 1000.0,
+                fairness_yield_ms=getattr(
+                    cfg.engine, "fairness_yield_ms", None
+                ),
+            )
         # --- tick loop
         self._tick_ms = cfg.rtt_millisecond
         self._tick_thread = threading.Thread(
@@ -940,8 +946,15 @@ class NodeHost(IMessageHandler):
         """cf. nodehost.go:1668-1684 tickWorkerMain."""
         period = self._tick_ms / 1000.0
         next_t = time.monotonic() + period
+        next_gauges_t = time.monotonic() + 1.0
         while not self._stopped.is_set():
             now = time.monotonic()
+            if now >= next_gauges_t:
+                next_gauges_t = now + 1.0
+                try:
+                    self._export_health_gauges()
+                except Exception:
+                    pass  # gauge export must never kill the tick loop
             if now < next_t:
                 time.sleep(min(period, next_t - now))
                 continue
@@ -960,6 +973,42 @@ class NodeHost(IMessageHandler):
                         n.mq.add(Message(type=MessageType.LOCAL_TICK))
                         self.engine.set_node_ready(n.cluster_id)
                 self._chunks.tick()  # abandoned inbound stream GC
+
+    def _export_health_gauges(self) -> None:
+        """Refresh host-level gauges (label key (0, 0)) in the
+        MetricsRegistry: the engine's tick-fairness watchdog and the
+        transport's breaker/queue state. Runs ~1/s on the tick thread so
+        the Prometheus exposition (write_health_metrics) always carries a
+        recent starvation/backpressure picture."""
+        fairness = getattr(self.engine, "fairness_stats", None)
+        if fairness is not None:
+            s = fairness()
+            key = (0, 0)
+            self.metrics.set_gauge(
+                "engine_tick_starvation_ratio", key, s["starvation_ratio"]
+            )
+            self.metrics.set_gauge(
+                "engine_tick_gap_max_seconds", key, s["recent_max_gap_s"]
+            )
+            self.metrics.set_gauge(
+                "engine_fairness_yields", key, s["fairness_yields"]
+            )
+            self.metrics.set_gauge(
+                "engine_tick_bursts_clamped", key, s["tick_bursts_clamped"]
+            )
+        tm = self.transport.metrics()
+        for name in (
+            "breakers_open",
+            "breaker_probe_failures",
+            "dropped_while_open",
+            "queue_evicted_bulk",
+            "queue_dropped_bulk",
+            "queue_dropped_urgent",
+            "queued_urgent",
+            "queued_bulk",
+        ):
+            if name in tm:
+                self.metrics.set_gauge(f"transport_{name}", (0, 0), tm[name])
 
 
 __all__ = [

@@ -7,9 +7,14 @@ two built-in stores:
 
   - MemKV: in-process ordered dict (tests, benchmarks, loopback slices)
   - WalKV: durable append-only WAL + in-memory table; write batches are
-    appended and fsynced as one record group, compaction rewrites the live
-    table to a fresh file with atomic rename (crash-safe: a torn tail
-    record is detected by CRC and discarded on replay)
+    appended and fsynced as one record group sealed by a commit record,
+    compaction rewrites the live table to a fresh file with atomic rename
+    (crash-safe: a torn or corrupt tail is detected by CRC/framing,
+    replay rolls back to the last sealed group and the reopen truncates
+    the discarded tail — batches apply atomically or not at all).
+    FORMAT NOTE: the commit-seal framing is WAL format v2 (shared with
+    native/walkv.cc); v1 files (per-record, no seals) are NOT readable —
+    their records replay as one unsealed group and are discarded.
 
 Keys are bytes and compare lexicographically; the key schema (keys.py) uses
 big-endian ids so numeric order == byte order.
@@ -26,6 +31,11 @@ _REC = struct.Struct("<IBII")  # total_len, op, klen, vlen
 _OP_PUT = 0
 _OP_DEL = 1
 _OP_RANGE_DEL = 2
+# group-commit seal: a write batch's records only apply on replay once its
+# trailing COMMIT record is intact — a torn tail can no longer surface a
+# HALF-applied batch (atomicity of IWriteBatch survives the crash, not
+# just individual records)
+_OP_COMMIT = 3
 
 
 class WriteBatch:
@@ -180,9 +190,70 @@ class MemKV(IKVStore):
             self._range_del(fk, lk)
 
 
+def _scan_groups(data: bytes, on_group: Callable) -> int:
+    """Walk a WAL byte stream group by group; call on_group(ops) at each
+    intact _OP_COMMIT seal. Returns the byte offset just past the last
+    applied seal.
+
+    The record-group contract (the WAL decoder the fuzz harness drives,
+    see fuzz.fuzz_wal_recovery): records accumulate into a pending group;
+    only an intact _OP_COMMIT seal applies the group. Any torn, corrupt or
+    absurd record (CRC mismatch, short tail, length fields past the
+    buffer) ends replay at the last sealed group — recovery NEVER crashes,
+    never half-applies a batch, and never accepts a record whose CRC does
+    not match.
+
+    The returned sealed offset matters to the writer: it must TRUNCATE
+    its WAL there before appending again, or a torn tail would strand
+    later writes behind a broken record — or worse, merge stale unsealed
+    records into the next batch's group."""
+    pending: List[Tuple[int, bytes, bytes]] = []
+    off = 0
+    sealed = 0
+    n = len(data)
+    while off + _REC.size <= n:
+        total, op, klen, vlen = _REC.unpack_from(data, off)
+        end = off + _REC.size + klen + vlen + 4
+        if end > n or total != _REC.size + klen + vlen + 4:
+            break  # torn tail / corrupt length fields
+        (crc,) = struct.unpack_from("<I", data, end - 4)
+        if zlib.crc32(data[off : end - 4]) != crc:
+            break  # torn/corrupt tail: stop replay here
+        if op == _OP_COMMIT:
+            if pending:
+                on_group(pending)
+                pending = []
+            sealed = end
+        elif op in (_OP_PUT, _OP_DEL, _OP_RANGE_DEL):
+            pending.append(
+                (
+                    op,
+                    bytes(data[off + _REC.size : off + _REC.size + klen]),
+                    bytes(data[off + _REC.size + klen : end - 4]),
+                )
+            )
+        else:
+            break  # unknown op: cannot trust anything past it
+        off = end
+    # a trailing unsealed group is a crash mid-batch: discarded
+    return sealed
+
+
+def _decode_records(data: bytes) -> Tuple[WriteBatch, int]:
+    """Collect every committed op of a WAL stream into one WriteBatch
+    (plus the sealed offset). Convenience wrapper over _scan_groups for
+    tests/fuzz; the replay path applies groups incrementally instead so a
+    large store never holds a second full copy of itself in op form."""
+    wb = WriteBatch()
+    sealed = _scan_groups(data, lambda ops: wb.ops.extend(ops))
+    return wb, sealed
+
+
 class WalKV(IKVStore):
     """Durable WAL-backed store. All reads served from the in-memory table;
-    durability from the fsynced append-only log."""
+    durability from the fsynced append-only log. Batches are framed as
+    record GROUPS sealed by a commit record (_decode_records), so a torn
+    tail rolls back to the last intact group on replay."""
 
     def __init__(self, dirname: str, fsync: bool = True) -> None:
         self._dir = dirname
@@ -206,21 +277,22 @@ class WalKV(IKVStore):
                 continue
             with open(path, "rb") as f:
                 data = f.read()
-            off = 0
-            wb = WriteBatch()
-            while off + _REC.size <= len(data):
-                total, op, klen, vlen = _REC.unpack_from(data, off)
-                end = off + _REC.size + klen + vlen + 4
-                if end > len(data):
-                    break  # torn tail
-                k = data[off + _REC.size : off + _REC.size + klen]
-                v = data[off + _REC.size + klen : end - 4]
-                (crc,) = struct.unpack_from("<I", data, end - 4)
-                if zlib.crc32(data[off : end - 4]) != crc:
-                    break  # torn/corrupt tail: stop replay here
-                wb.ops.append((op, bytes(k), bytes(v)))
-                off = end
-            self._mem.commit_write_batch(wb)
+
+            def apply_group(ops) -> None:
+                gwb = WriteBatch()
+                gwb.ops = ops
+                self._mem.commit_write_batch(gwb)
+
+            sealed = _scan_groups(data, apply_group)
+            if path == self._path and sealed < len(data):
+                # chop the discarded tail (torn group / corrupt record)
+                # BEFORE the append fd opens: appending after a broken
+                # record would strand the new writes behind it, and
+                # appending after intact-but-unsealed records would merge
+                # them into the next batch's sealed group (resurrecting a
+                # rolled-back batch)
+                with open(path, "r+b") as f:
+                    f.truncate(sealed)
 
     # -- reads ---------------------------------------------------------------
     def get_value(self, key):
@@ -238,6 +310,7 @@ class WalKV(IKVStore):
         with self._mu:
             for op, k, v in wb.ops:
                 self._append_rec(op, k, v)
+            self._append_rec(_OP_COMMIT, b"", b"")  # seal the group
             self._f.flush()
             if self._fsync:
                 os.fsync(self._f.fileno())
@@ -251,6 +324,7 @@ class WalKV(IKVStore):
         with self._mu:
             for op, k, v in wb.ops:
                 self._append_rec(op, k, v)
+            self._append_rec(_OP_COMMIT, b"", b"")  # seal the group
             self._f.flush()
             self._mem.commit_write_batch(wb)
             self._since_compact += len(wb.ops)
@@ -286,9 +360,18 @@ class WalKV(IKVStore):
                 self._mem.iterate_value(
                     b"", b"\xff" * 64, True, lambda k, v: (items.append((k, v)), True)[1]
                 )
-                for k, v in items:
+                seal = _REC.pack(_REC.size + 4, _OP_COMMIT, 0, 0)
+                seal += struct.pack("<I", zlib.crc32(seal))
+                # seal in chunks, not one table-sized group: replay
+                # buffers a group before applying, so one giant group
+                # would double peak memory at startup (the tmp+rename
+                # already makes the whole file all-or-nothing)
+                for i, (k, v) in enumerate(items):
                     rec = _REC.pack(_REC.size + len(k) + len(v) + 4, _OP_PUT, len(k), len(v)) + k + v
                     f.write(rec + struct.pack("<I", zlib.crc32(rec)))
+                    if (i + 1) % 1024 == 0:
+                        f.write(seal)
+                f.write(seal)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, final)

@@ -6,12 +6,32 @@ gets a lazily created queue + worker; the worker drains the queue into
 MessageBatches (bounded bytes per batch), reconnecting through the pluggable
 IRaftRPC. Send failures trip a per-target breaker and fan out Unreachable
 notifications to every (cluster, node) resolving to that address.
+
+Resilience hardening on top of the reference shape:
+
+  * `_Breaker` backs off exponentially with jitter and half-opens with a
+    single in-flight probe (cf. netutil/circuitbreaker usage
+    transport.go:299-311) instead of the fixed 1s cooldown — a flapping
+    peer costs O(log) reconnect storms, and jitter decorrelates many
+    senders hammering the same recovered target.
+  * `_SendQueue` is class-prioritized: control-plane traffic (heartbeats,
+    votes, TimeoutNow) is never queued behind — or pushed out by — bulk
+    replication under backpressure. When the queue is full, an arriving
+    urgent message evicts the oldest bulk message; urgent traffic is also
+    exempt from the byte rate limiter (it is tiny and liveness-critical:
+    a follower that cannot hear heartbeats behind a bulk backlog calls a
+    needless election).
+  * `metrics()` exposes breaker/queue state so chaos runs can assert the
+    above (e.g. "no heartbeat-class message was ever dropped from a full
+    queue").
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
+import random
+import zlib
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ..core.rate import RateLimiter
@@ -22,71 +42,248 @@ from .nodes import Nodes
 
 BIN_VER = 1
 
+# control-plane message classes that keep a cluster live; everything else
+# (Replicate, InstallSnapshot, ...) is bulk and yields to them
+URGENT_TYPES = frozenset(
+    {
+        MessageType.HEARTBEAT,
+        MessageType.HEARTBEAT_RESP,
+        MessageType.REQUEST_VOTE,
+        MessageType.REQUEST_VOTE_RESP,
+        MessageType.TIMEOUT_NOW,
+    }
+)
+
 
 class _Breaker:
-    """Minimal circuit breaker (cf. netutil/circuitbreaker usage
-    transport.go:299-311): opens after consecutive failures, half-opens
-    after a cooldown."""
+    """Circuit breaker with exponential backoff, jittered cooldowns and a
+    half-open single-probe state.
 
-    def __init__(self, threshold: int = 1, cooldown: float = 1.0) -> None:
+    States: CLOSED (traffic flows; consecutive failures >= threshold trip
+    it) and OPEN. While OPEN and cooling, enqueue and probe are both
+    refused. Once the cooldown elapses the breaker is effectively
+    half-open: traffic may enqueue again and the queue worker is granted
+    ONE in-flight probe send; the probe's outcome either closes the
+    breaker (success) or re-opens it with a doubled, jittered cooldown.
+    """
+
+    CLOSED, OPEN = 0, 1
+
+    def __init__(
+        self,
+        threshold: int = 1,
+        base_cooldown: float = 0.5,
+        max_cooldown: float = 15.0,
+        jitter: float = 0.25,
+        rng: Optional[random.Random] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self._threshold = threshold
-        self._cooldown = cooldown
-        self._fails = 0
-        self._opened_at = 0.0
+        self._base = base_cooldown
+        self._max = max_cooldown
+        self._jitter = jitter
+        self._rng = rng or random.Random()
+        self._clock = clock
         self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._fails = 0
+        self._nominal = base_cooldown  # pre-jitter cooldown, doubles per reopen
+        self._cooldown = 0.0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        # counters for metrics()/tests
+        self.opens = 0
+        self.probes = 0
+        self.probe_failures = 0
 
-    def ready(self) -> bool:
+    def _jittered(self, nominal: float) -> float:
+        j = self._jitter
+        return nominal * (1.0 + j * (2.0 * self._rng.random() - 1.0))
+
+    def _cooled_locked(self) -> bool:
+        return self._clock() - self._opened_at >= self._cooldown
+
+    # -- producer side -----------------------------------------------------
+    def allow_enqueue(self) -> bool:
         with self._mu:
-            if self._fails < self._threshold:
+            return self._state == self.CLOSED or self._cooled_locked()
+
+    # legacy name used by older call sites/tests
+    ready = allow_enqueue
+
+    # -- worker (wire-write) side ------------------------------------------
+    def allow_probe(self) -> bool:
+        """CLOSED: always. OPEN: one probe once the cooldown elapsed."""
+        with self._mu:
+            if self._state == self.CLOSED:
                 return True
-            return time.monotonic() - self._opened_at >= self._cooldown
+            if not self._cooled_locked() or self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            self.probes += 1
+            return True
 
     def success(self) -> None:
         with self._mu:
+            self._state = self.CLOSED
             self._fails = 0
+            self._nominal = self._base
+            self._probe_inflight = False
 
     def fail(self) -> None:
         with self._mu:
-            self._fails += 1
-            if self._fails >= self._threshold:
-                self._opened_at = time.monotonic()
+            if self._state == self.CLOSED:
+                self._fails += 1
+                if self._fails < self._threshold:
+                    return
+                self._state = self.OPEN
+                self.opens += 1
+                self._nominal = self._base
+            else:
+                # a failed half-open probe (or a straggler failure while
+                # open): back off exponentially, re-arm the cooldown
+                if self._probe_inflight:
+                    self.probe_failures += 1
+                self._nominal = min(self._max, self._nominal * 2.0)
+            self._probe_inflight = False
+            self._cooldown = self._jittered(self._nominal)
+            self._opened_at = self._clock()
+
+    # -- introspection -----------------------------------------------------
+    def is_open(self) -> bool:
+        with self._mu:
+            return self._state == self.OPEN
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "state": "open" if self._state == self.OPEN else "closed",
+                "consecutive_failures": self._fails,
+                "cooldown_s": self._cooldown,
+                "nominal_cooldown_s": self._nominal,
+                "opens": self.opens,
+                "probes": self.probes,
+                "probe_failures": self.probe_failures,
+            }
 
 
 class _SendQueue:
-    """Per-target outbound queue: count-bounded by the queue itself and
-    byte-bounded by a RateLimiter when NodeHostConfig.max_send_queue_size
-    is set (cf. transport.go:170-185 sendQueueRateLimited — an unbounded
-    byte backlog toward one dead peer would otherwise hold entry payloads
-    alive indefinitely)."""
+    """Per-target outbound queue, class-prioritized and byte-bounded.
+
+    Two deques under one condition variable: urgent control-plane traffic
+    (URGENT_TYPES) and bulk. Consumers always drain urgent first. The
+    count bound covers both classes; byte accounting via RateLimiter
+    applies to bulk only (cf. transport.go:170-185 sendQueueRateLimited —
+    an unbounded byte backlog toward one dead peer would otherwise hold
+    entry payloads alive indefinitely; urgent messages carry no payload).
+    Under a full queue an urgent arrival evicts the OLDEST bulk message —
+    replication recovers by retransmission, a lost heartbeat costs an
+    election."""
+
+    __slots__ = (
+        "_maxlen",
+        "_urgent",
+        "_bulk",
+        "_cv",
+        "_closed",
+        "rl",
+        "thread",
+        "evicted_bulk",
+        "dropped_bulk",
+        "dropped_urgent",
+    )
 
     def __init__(self, maxlen: int, max_bytes: int = 0) -> None:
-        self.q: "queue.Queue[Optional[Message]]" = queue.Queue(maxlen)
-        self.thread: Optional[threading.Thread] = None
+        self._maxlen = maxlen
+        self._urgent: deque = deque()
+        self._bulk: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
         self.rl = RateLimiter(max_bytes)
-        # RateLimiter is not thread-safe; producer (engine threads) and
-        # consumer (queue worker) both touch it
-        self._rl_mu = threading.Lock()
+        self.thread: Optional[threading.Thread] = None
+        self.evicted_bulk = 0  # bulk pushed out to admit urgent
+        self.dropped_bulk = 0  # bulk refused (full queue / byte limit)
+        self.dropped_urgent = 0  # urgent refused (queue full of urgent)
 
-    def try_put(self, m: Message) -> bool:
-        # account BEFORE enqueueing: the consumer may dequeue and decrease
-        # the instant put_nowait returns, and a decrease-before-increase
-        # pair would clamp at 0 then leak the increase forever
-        sz = _msg_size(m)
-        with self._rl_mu:
-            if self.rl.enabled and self.rl.rate_limited():
-                return False
-            self.rl.increase(sz)
-        try:
-            self.q.put_nowait(m)
-        except queue.Full:
-            with self._rl_mu:
-                self.rl.decrease(sz)
+    def _admit_locked(self, m: Message) -> bool:
+        urgent = m.type in URGENT_TYPES
+        if not urgent and self.rl.enabled and self.rl.rate_limited():
+            self.dropped_bulk += 1
             return False
+        if len(self._urgent) + len(self._bulk) >= self._maxlen:
+            if urgent and self._bulk:
+                ev = self._bulk.popleft()
+                self.rl.decrease(_msg_size(ev))
+                self.evicted_bulk += 1
+            elif urgent:
+                self.dropped_urgent += 1
+                return False
+            else:
+                self.dropped_bulk += 1
+                return False
+        if urgent:
+            self._urgent.append(m)  # never charged to the byte budget
+        else:
+            self.rl.increase(_msg_size(m))
+            self._bulk.append(m)
         return True
 
-    def taken(self, m: Message) -> None:
-        with self._rl_mu:
+    def try_put(self, m: Message) -> bool:
+        with self._cv:
+            if self._closed:
+                return False
+            ok = self._admit_locked(m)
+            if ok:
+                self._cv.notify()
+            return ok
+
+    def put_many(self, msgs: List[Message]) -> int:
+        """Admit a whole target batch under ONE lock acquisition + ONE
+        consumer wake (the engine's columnar fan-out emits one such batch
+        per destination per step)."""
+        with self._cv:
+            if self._closed:
+                return 0
+            n = 0
+            for m in msgs:
+                if self._admit_locked(m):
+                    n += 1
+            if n:
+                self._cv.notify()
+            return n
+
+    def _pop_locked(self) -> Optional[Message]:
+        if self._urgent:
+            return self._urgent.popleft()  # urgent was never rl-charged
+        if self._bulk:
+            m = self._bulk.popleft()
             self.rl.decrease(_msg_size(m))
+            return m
+        return None
+
+    def get(self, timeout: float) -> Optional[Message]:
+        """Urgent-first pop; None on timeout or close."""
+        with self._cv:
+            if not self._urgent and not self._bulk and not self._closed:
+                self._cv.wait(timeout)
+            return self._pop_locked()
+
+    def get_nowait(self) -> Optional[Message]:
+        with self._cv:
+            return self._pop_locked()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def depths(self) -> tuple:
+        with self._cv:
+            return len(self._urgent), len(self._bulk)
 
 
 class Transport:
@@ -117,6 +314,7 @@ class Transport:
             "received": 0,
             "connect_attempts": 0,
             "connect_failures": 0,
+            "dropped_while_open": 0,
         }
         self.rpc: IRaftRPC = rpc_factory(
             request_handler=self._handle_request,
@@ -124,7 +322,9 @@ class Transport:
         )
         # snapshot chunk sink installed by the snapshot subsystem
         self._chunk_sink: Optional[Callable] = None
-        # monkey-test hooks (cf. transport.go:281-289)
+        # monkey-test hooks (cf. transport.go:281-289); the hook may also
+        # MUTATE batch.requests in place (FaultPlane drop/duplicate/
+        # reorder run per-message inside the batch)
         self._pre_send_batch_hook: Optional[Callable] = None
 
     # -- lifecycle -------------------------------------------------------------
@@ -137,10 +337,7 @@ class Transport:
             qs = list(self._queues.values())
             self._queues.clear()
         for sq in qs:
-            try:
-                sq.q.put_nowait(None)
-            except queue.Full:
-                pass
+            sq.close()
         for sq in qs:
             if sq.thread is not None:
                 sq.thread.join(timeout=2)
@@ -156,7 +353,38 @@ class Transport:
         self._pre_send_batch_hook = hook
 
     def metrics(self) -> dict:
-        return dict(self._metrics)
+        """Flat numeric snapshot: wire counters plus aggregate breaker and
+        queue state (per-address detail via breaker_states())."""
+        out = dict(self._metrics)
+        with self._mu:
+            breakers = list(self._breakers.values())
+            queues = list(self._queues.values())
+        out["breakers_open"] = sum(1 for b in breakers if b.is_open())
+        out["breaker_opens"] = sum(b.opens for b in breakers)
+        out["breaker_probes"] = sum(b.probes for b in breakers)
+        out["breaker_probe_failures"] = sum(
+            b.probe_failures for b in breakers
+        )
+        qu = qb = 0
+        ev = db = du = 0
+        for sq in queues:
+            u, b = sq.depths()
+            qu += u
+            qb += b
+            ev += sq.evicted_bulk
+            db += sq.dropped_bulk
+            du += sq.dropped_urgent
+        out["queued_urgent"] = qu
+        out["queued_bulk"] = qb
+        out["queue_evicted_bulk"] = ev
+        out["queue_dropped_bulk"] = db
+        out["queue_dropped_urgent"] = du
+        return out
+
+    def breaker_states(self) -> Dict[str, dict]:
+        with self._mu:
+            breakers = list(self._breakers.items())
+        return {addr: b.snapshot() for addr, b in breakers}
 
     # -- receive path ----------------------------------------------------------
     def _handle_request(self, batch: MessageBatch) -> None:
@@ -192,10 +420,11 @@ class Transport:
 
     def send_many(self, msgs) -> int:
         """Queue many messages in one pass: resolve and group by target
-        address first, then amortize the breaker check and queue lookup
-        over each target's whole batch (the engine's columnar fan-out
-        emits one such batch per step instead of per-message send()
-        calls). Returns how many messages were queued."""
+        address first, then amortize the breaker check, queue lookup AND
+        the queue lock over each target's whole batch (the engine's
+        columnar fan-out emits one such batch per step instead of
+        per-message send() calls). Returns how many messages were
+        queued."""
         if not msgs:
             return 0
         by_addr: Dict[str, List[Message]] = {}
@@ -209,28 +438,27 @@ class Transport:
         if self._stopped.is_set():
             return 0
         for addr, ms in by_addr.items():
-            if not self._get_breaker(addr).ready():
+            if not self._get_breaker(addr).allow_enqueue():
                 continue
-            sq = self._get_queue(addr)
-            for m in ms:
-                if sq.try_put(m):
-                    sent += 1
+            sent += self._get_queue(addr).put_many(ms)
         return sent
 
     def send_to_address(self, addr: str, m: Message) -> bool:
         if self._stopped.is_set():
             return False
-        breaker = self._get_breaker(addr)
-        if not breaker.ready():
+        if not self._get_breaker(addr).allow_enqueue():
             return False
-        sq = self._get_queue(addr)
-        return sq.try_put(m)
+        return self._get_queue(addr).try_put(m)
 
     def _get_breaker(self, addr: str) -> _Breaker:
         with self._mu:
             b = self._breakers.get(addr)
             if b is None:
-                b = self._breakers[addr] = _Breaker()
+                # deterministic per-address jitter stream so chaos runs
+                # replay with identical breaker timing
+                b = self._breakers[addr] = _Breaker(
+                    rng=random.Random(zlib.crc32(addr.encode()))
+                )
             return b
 
     def _get_queue(self, addr: str) -> _SendQueue:
@@ -254,23 +482,17 @@ class Transport:
         breaker = self._get_breaker(addr)
         try:
             while not self._stopped.is_set():
-                try:
-                    m = sq.q.get(timeout=0.5)
-                except queue.Empty:
-                    continue
+                m = sq.get(timeout=0.5)
                 if m is None:
-                    return
-                sq.taken(m)
+                    if sq.closed:
+                        return
+                    continue
                 requests = [m]
                 size = _msg_size(m)
                 while size < soft.max_message_batch_size:
-                    try:
-                        m2 = sq.q.get_nowait()
-                    except queue.Empty:
-                        break
+                    m2 = sq.get_nowait()
                     if m2 is None:
-                        return
-                    sq.taken(m2)
+                        break
                     requests.append(m2)
                     size += _msg_size(m2)
                 # the message that crossed the byte cap ships in a second
@@ -290,6 +512,16 @@ class Transport:
                     if self._pre_send_batch_hook is not None:
                         if not self._pre_send_batch_hook(batch):
                             continue  # dropped by chaos hook
+                        if not batch.requests:
+                            continue  # chaos hook drained the batch
+                    if not breaker.allow_probe():
+                        # open + cooling: shed the queued traffic instead
+                        # of hammering a dead peer (the reference drops
+                        # queued traffic for the cooldown window too)
+                        self._metrics["dropped_while_open"] += len(
+                            batch.requests
+                        )
+                        continue
                     try:
                         if conn is None:
                             self._metrics["connect_attempts"] += 1
@@ -308,7 +540,9 @@ class Transport:
                             conn = None
                         breaker.fail()
                         self._notify_unreachable(addr)
-                        # drop queued traffic for the cooldown window
+                        # brief pause so a hard-down peer does not spin
+                        # this worker; the breaker cooldown does the real
+                        # shedding
                         time.sleep(0.05)
         finally:
             if conn is not None:
@@ -334,4 +568,4 @@ def _msg_size(m: Message) -> int:
     return 64 + sum(len(e.cmd) + 48 for e in m.entries)
 
 
-__all__ = ["Transport", "BIN_VER"]
+__all__ = ["Transport", "BIN_VER", "URGENT_TYPES"]

@@ -11,9 +11,11 @@
 // The on-disk record format is byte-compatible with the pure-Python WalKV
 // (dragonboat_tpu/storage/kv.py): little-endian header
 //   {u32 total_len, u8 op, u32 klen, u32 vlen} key value {u32 crc32}
-// where crc32 covers header+key+value. A torn or corrupt tail record is
-// detected by CRC/length and replay stops there (same recovery rule as the
-// reference's WAL usage and kv.py:_replay).
+// where crc32 covers header+key+value. Records accumulate into GROUPS
+// sealed by an OP_COMMIT record; replay applies a group only when its
+// seal is intact, so a torn or corrupt tail rolls back to the last sealed
+// group — write batches recover atomically or not at all (same framing
+// and recovery rule as kv.py:_decode_records).
 //
 // C ABI (ctypes-friendly): every call crosses the FFI once per *batch* or
 // per *range*, never per key — the Python side serializes a whole write
@@ -49,6 +51,10 @@ namespace {
 constexpr uint8_t OP_PUT = 0;
 constexpr uint8_t OP_DEL = 1;
 constexpr uint8_t OP_RANGE_DEL = 2;
+// group-commit seal (format-shared with the Python WalKV): a batch's
+// records only apply on replay once the trailing COMMIT record is intact,
+// so a torn tail rolls back whole batches, never half of one
+constexpr uint8_t OP_COMMIT = 3;
 constexpr size_t HDR = 4 + 1 + 4 + 4;  // total_len, op, klen, vlen
 
 inline void put_u32(std::string& b, uint32_t v) {
@@ -85,7 +91,20 @@ class WalKV {
     Replay(dir_ + "/table.log");
     ScanSegments();
     for (uint64_t s : segments_) Replay(SegPath(s));
-    Replay(dir_ + "/wal.log");
+    size_t sealed = Replay(dir_ + "/wal.log");
+    // chop any discarded tail (torn group / corrupt record) before the
+    // append fd opens: appending after a broken record would strand the
+    // new writes behind it, and appending after intact-but-unsealed
+    // records would merge them into the next batch's sealed group
+    // (resurrecting a rolled-back batch)
+    struct stat wst;
+    if (::stat((dir_ + "/wal.log").c_str(), &wst) == 0 &&
+        static_cast<size_t>(wst.st_size) > sealed) {
+      if (::truncate((dir_ + "/wal.log").c_str(),
+                     static_cast<off_t>(sealed)) != 0) {
+        return "cannot truncate torn wal.log tail in " + dir_;
+      }
+    }
     fd_ = ::open((dir_ + "/wal.log").c_str(), O_WRONLY | O_CREAT | O_APPEND,
                  0644);
     if (fd_ < 0) return "cannot open wal.log in " + dir_;
@@ -136,6 +155,7 @@ class WalKV {
     std::lock_guard<std::mutex> g(mu_);
     std::string buf;
     for (const auto& o : ops) AppendRec(buf, o);
+    AppendSeal(buf);
     if (AppendDurable(buf) != 0) return -2;
     for (const auto& o : ops) Apply(o);
     pending_compact_ += ops.size();
@@ -166,6 +186,7 @@ class WalKV {
     std::lock_guard<std::mutex> g(mu_);
     std::string buf;
     AppendRec(buf, o);
+    AppendSeal(buf);
     if (AppendDurable(buf) != 0) return -2;
     Apply(o);
     ++pending_compact_;
@@ -185,6 +206,11 @@ class WalKV {
       Op o{OP_PUT, kv.first, kv.second};
       AppendRec(buf, o);
       if (buf.size() > (1u << 20)) {
+        // seal per chunk, not one table-sized group: replay buffers a
+        // group before applying, and one giant group would double peak
+        // memory at startup (tmp+rename already makes the whole file
+        // all-or-nothing)
+        AppendSeal(buf);
         if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
           ::close(tfd);
           return -2;
@@ -192,6 +218,7 @@ class WalKV {
         buf.clear();
       }
     }
+    AppendSeal(buf);
     if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
       ::close(tfd);
       return -2;
@@ -341,6 +368,7 @@ class WalKV {
       Op o{OP_PUT, kv.first, kv.second};
       AppendRec(buf, o);
       if (buf.size() > (1u << 20)) {
+        AppendSeal(buf);  // per-chunk seal, same as FullCompaction
         if (WriteAll(tfd, buf.data(), buf.size()) != 0) {
           ::close(tfd);
           return -2;
@@ -348,6 +376,7 @@ class WalKV {
         buf.clear();
       }
     }
+    AppendSeal(buf);
     if (WriteAll(tfd, buf.data(), buf.size()) != 0 || ::fsync(tfd) != 0) {
       ::close(tfd);
       return -3;
@@ -409,6 +438,11 @@ class WalKV {
     return 0;
   }
 
+  void AppendSeal(std::string& buf) {
+    Op seal{OP_COMMIT, "", ""};
+    AppendRec(buf, seal);
+  }
+
   void AppendRec(std::string& buf, const Op& o) {
     std::string rec;
     rec.reserve(HDR + o.k.size() + o.v.size() + 4);
@@ -445,19 +479,24 @@ class WalKV {
     }
   }
 
-  void Replay(const std::string& path) {
+  // Returns the byte offset just past the last APPLIED seal (0 when the
+  // file is missing/empty): the caller truncates the active WAL there
+  // before appending again.
+  size_t Replay(const std::string& path) {
     FILE* f = ::fopen(path.c_str(), "rb");
-    if (!f) return;
+    if (!f) return 0;
     ::fseek(f, 0, SEEK_END);
     long sz = ::ftell(f);
     ::fseek(f, 0, SEEK_SET);
     std::vector<uint8_t> data(static_cast<size_t>(sz));
     if (sz > 0 && ::fread(data.data(), 1, data.size(), f) != data.size()) {
       ::fclose(f);
-      return;
+      return 0;
     }
     ::fclose(f);
     size_t off = 0;
+    size_t sealed = 0;
+    std::vector<Op> pending;  // current unsealed record group
     while (off + HDR <= data.size()) {
       uint32_t total = get_u32(&data[off]);
       uint8_t op = data[off + 4];
@@ -469,14 +508,24 @@ class WalKV {
       uint32_t got = static_cast<uint32_t>(
           ::crc32(0, &data[off], static_cast<uInt>(end - 4 - off)));
       if (want != got) break;  // torn/corrupt tail
-      Op o;
-      o.op = op;
-      o.k.assign(reinterpret_cast<const char*>(&data[off + HDR]), klen);
-      o.v.assign(reinterpret_cast<const char*>(&data[off + HDR + klen]),
-                 vlen);
-      Apply(o);
+      if (op == OP_COMMIT) {
+        for (const auto& p : pending) Apply(p);
+        pending.clear();
+        sealed = end;
+      } else if (op <= OP_RANGE_DEL) {
+        Op o;
+        o.op = op;
+        o.k.assign(reinterpret_cast<const char*>(&data[off + HDR]), klen);
+        o.v.assign(reinterpret_cast<const char*>(&data[off + HDR + klen]),
+                   vlen);
+        pending.push_back(std::move(o));
+      } else {
+        break;  // unknown op: nothing past it can be trusted
+      }
       off = end;
     }
+    // a trailing unsealed group is a crash mid-batch: discarded
+    return sealed;
   }
 
   std::string dir_;

@@ -22,6 +22,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration/chaos tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded FaultPlane chaos tests — bounded enough for tier-1; "
+        "select the matrix alone with `-m chaos` (seeds print on failure "
+        "so any run replays from the CI log)",
+    )
 
 
 # ---- hang diagnosis (the Python half of the race-detection story; see

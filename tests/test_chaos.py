@@ -3,8 +3,11 @@
 Mirrors the reference's monkey-test methodology (docs/test.md:11-33,
 monkey.go): a 3-host loopback cluster runs client traffic while faults are
 injected — transport message drops, full partitions of one host at a time,
-and a NodeHost kill+restart from its durable dir. Invariants checked at
-the end (after fault injection stops and the cluster settles):
+and a NodeHost kill+restart from its durable dir. All fault decisions come
+from ONE seeded FaultPlane (dragonboat_tpu/faults.py), printed at test
+start: a CI failure replays by re-running with CHAOS_SEED=<printed seed>.
+Invariants checked at the end (after fault injection stops and the cluster
+settles):
 
   1. no linearizability violation in the recorded client history
   2. all replicas' state machines converge to the same content hash
@@ -13,6 +16,7 @@ the end (after fault injection stops and the cluster settles):
 cf. SURVEY.md §4: "no linearizability violation, SMs in sync".
 """
 import json
+import os
 import random
 import threading
 import time
@@ -20,6 +24,7 @@ import time
 import pytest
 
 from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.faults import FaultPlane, FaultSpec
 from dragonboat_tpu.lincheck import HistoryRecorder, check_kv_history
 from dragonboat_tpu.nodehost import NodeHost
 from dragonboat_tpu.requests import RequestError
@@ -29,6 +34,7 @@ from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
 CLUSTER = 1
 HOSTS = (1, 2, 3)
 KEYS = [f"k{i}" for i in range(4)]
+SEED = int(os.environ.get("CHAOS_SEED", str(0xD5A60)), 0)
 
 
 class HashKV(IStateMachine):
@@ -102,7 +108,9 @@ def _find_leader(hosts, deadline_s=20):
 @pytest.mark.slow
 @pytest.mark.parametrize("engine_kind", ["scalar", "vector"])
 def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
-    rng = random.Random(0xD5A60)
+    print(f"CHAOS SEED=0x{SEED:X} (replay: CHAOS_SEED=0x{SEED:X})")
+    # ~30% outbound message drop while a drop window is armed on a victim
+    fp = FaultPlane(SEED, FaultSpec(drop=0.3))
     reg = _Registry()
     hosts = {
         nid: _mk_host(nid, reg, str(tmp_path), engine_kind) for nid in HOSTS
@@ -114,7 +122,7 @@ def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
 
     def client_main(client_id):
         # per-thread RNG: the shared seed stays reproducible per client
-        crng = random.Random(0xD5A60 + client_id)
+        crng = random.Random(SEED + client_id)
         while not stop.is_set():
             leader = _find_leader(hosts, deadline_s=5)
             if leader is None:
@@ -158,35 +166,34 @@ def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
         t.start()
 
     # -------- fault injection: drops, partitions, kill+restart ------------
+    # every decision below draws from the FaultPlane's seeded "faultloop"
+    # stream; the per-message drop schedule draws from the armed victim's
+    # own "wire:h<N>" stream (single-threaded per transport worker)
     t_end = time.time() + 20
     while time.time() < t_end:
-        fault = rng.choice(["partition", "drop", "restart", "none"])
-        victim = rng.choice(HOSTS)
+        fault = fp.choice(
+            "faultloop", "fault", ["partition", "drop", "restart", "none"]
+        )
+        victim = fp.choice("faultloop", "victim", HOSTS)
         nh = hosts.get(victim)
         if nh is None:
             continue
         if fault == "partition":
             nh.set_partitioned(True)
-            time.sleep(rng.uniform(0.3, 0.8))
+            time.sleep(fp.uniform("faultloop", "window", 0.3, 0.8))
             nh2 = hosts.get(victim)
             if nh2 is not None:
                 nh2.set_partitioned(False)
         elif fault == "drop":
-            # drop ~30% of outbound batches for a while (own RNG: the hook
-            # runs on transport threads, keep the fault-loop rng single-
-            # threaded)
-            drop_rng = random.Random(rng.random())
-            nh.transport.set_pre_send_batch_hook(
-                lambda batch: drop_rng.random() > 0.3
-            )
-            time.sleep(rng.uniform(0.3, 0.8))
+            fp.install(nh, f"h{victim}")
+            time.sleep(fp.uniform("faultloop", "window", 0.3, 0.8))
             nh2 = hosts.get(victim)
             if nh2 is not None:
-                nh2.transport.set_pre_send_batch_hook(None)
+                fp.uninstall(nh2)
         elif fault == "restart":
             hosts[victim] = None
             nh.stop()
-            time.sleep(rng.uniform(0.1, 0.3))
+            time.sleep(fp.uniform("faultloop", "window", 0.1, 0.3))
             hosts[victim] = _mk_host(victim, reg, str(tmp_path), engine_kind)
         else:
             time.sleep(0.3)
@@ -195,6 +202,7 @@ def test_chaos_linearizable_and_converged(tmp_path, engine_kind):
     stop.set()
     for t in clients:
         t.join(timeout=5)
+    fp.uninstall_all()
     for nid in HOSTS:
         if hosts[nid] is not None:
             hosts[nid].set_partitioned(False)

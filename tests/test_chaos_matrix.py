@@ -1,0 +1,195 @@
+"""Bounded FaultPlane seed matrix (`-m chaos`): each seed drives a SHORT
+drop+partition schedule against a 3-host shared-core vector cluster and
+asserts recovery + convergence. Small enough for the tier-1 budget; the
+seed prints at the start so any CI failure replays bit-identically by
+pinning CHAOS_SEED.
+
+The long free-form chaos runs stay in test_chaos.py / test_chaos_scale.py
+(marked slow); this matrix is the fast regression net over the FaultPlane
+seams themselves.
+"""
+import json
+import os
+import threading
+import time
+import zlib
+
+import pytest
+
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.faults import REPLICATION_TYPES, FaultPlane, FaultSpec
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+CLUSTER = 2
+HOSTS = (1, 2, 3)
+
+SEEDS = [11, 29, 47]
+_env_seed = os.environ.get("CHAOS_SEED")
+if _env_seed:
+    SEEDS = [int(_env_seed, 0)]
+
+
+class KV(IStateMachine):
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=1)
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def get_hash(self):
+        return zlib.crc32(json.dumps(sorted(self.d.items())).encode())
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+def _mk_host(nid, reg, tmp, seed):
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=11,
+            rtt_millisecond=5,
+            nodehost_dir=f"{tmp}/h{nid}",
+            raft_address=f"cm{nid}:1",
+            raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+            engine=EngineConfig(
+                kind="vector",
+                max_groups=32,
+                max_peers=4,
+                log_window=64,
+                share_scope=f"chaos-matrix-{seed}",
+            ),
+        )
+    )
+    nh.start_cluster(
+        {h: f"cm{h}:1" for h in HOSTS},
+        False,
+        lambda c, n: KV(),
+        Config(
+            cluster_id=CLUSTER,
+            node_id=nid,
+            election_rtt=20,
+            heartbeat_rtt=4,
+            snapshot_entries=0,
+        ),
+    )
+    return nh
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", SEEDS)
+def test_seed_matrix_drop_partition_converges(tmp_path, seed):
+    print(f"CHAOS SEED={seed} (replay: CHAOS_SEED={seed} pytest -m chaos)")
+    # drops target replication only on the co-hosted path — the control
+    # plane stays lossless so the matrix stresses data-plane recovery
+    fp = FaultPlane(seed, FaultSpec(drop=0.3, only_types=REPLICATION_TYPES))
+    reg = _Registry()
+    hosts = {nid: _mk_host(nid, reg, str(tmp_path), seed) for nid in HOSTS}
+    core = hosts[1].engine.core
+    try:
+        deadline = time.monotonic() + 60
+        leader = None
+        while leader is None and time.monotonic() < deadline:
+            for nid, nh in hosts.items():
+                lid, ok = nh.get_leader_id(CLUSTER)
+                if ok and lid == nid:
+                    leader = nid
+                    break
+            time.sleep(0.02)
+        assert leader is not None, f"no leader elected (seed={seed})"
+
+        # writer thread keeps proposing through the fault window
+        stop = threading.Event()
+        committed = [0]
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                for nid, nh in hosts.items():
+                    lid, ok = nh.get_leader_id(CLUSTER)
+                    if not ok or lid != nid or nh.is_partitioned():
+                        continue
+                    n += 1
+                    try:
+                        nh.sync_propose(
+                            nh.get_noop_session(CLUSTER),
+                            f"k{n % 4}=v{n}".encode(),
+                            timeout_s=1.0,
+                        )
+                        committed[0] += 1
+                    except Exception:
+                        pass
+                    break
+                else:
+                    time.sleep(0.05)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+
+        core.set_local_drop_hook(fp.message_hook("local:core"))
+        for victim, window, idle in fp.partition_schedule(
+            "faultloop", HOSTS, total_s=4.0, min_window_s=0.2, max_window_s=0.5
+        ):
+            hosts[victim].set_partitioned(True)
+            time.sleep(window)
+            hosts[victim].set_partitioned(False)
+            time.sleep(idle)
+        core.set_local_drop_hook(None)
+        for nh in hosts.values():
+            nh.set_partitioned(False)
+        # healed window, adaptive for loaded CI boxes: the writer keeps
+        # going until at least one proposal commits
+        deadline = time.monotonic() + 30
+        while committed[0] == 0 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        stop.set()
+        t.join(timeout=5)
+        assert committed[0] > 0, f"nothing committed under seed {seed}"
+
+        # final write + full convergence
+        deadline = time.monotonic() + 45
+        while True:
+            try:
+                for nid, nh in hosts.items():
+                    lid, ok = nh.get_leader_id(CLUSTER)
+                    if ok and lid == nid:
+                        nh.sync_propose(
+                            nh.get_noop_session(CLUSTER), b"final=done", 5.0
+                        )
+                        raise StopIteration
+                time.sleep(0.1)
+            except StopIteration:
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            idx = {n: hosts[n].get_applied_index(CLUSTER) for n in HOSTS}
+            if len(set(idx.values())) == 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"seed {seed}: applied indexes never converged: {idx}"
+            )
+        hashes = {n: hosts[n].get_sm_hash(CLUSTER) for n in HOSTS}
+        assert len(set(hashes.values())) == 1, (
+            f"seed {seed}: SM divergence {hashes}"
+        )
+        # control-plane protection held under backpressure
+        for nid, nh in hosts.items():
+            assert nh.transport.metrics()["queue_dropped_urgent"] == 0
+    finally:
+        for nh in hosts.values():
+            nh.stop()

@@ -1,0 +1,192 @@
+"""Tick-fairness watchdog tests: starvation gauge semantics, the enforced
+yield when a long iteration starved a co-scheduled peer loop, the tick
+burst clamp that keeps randomized election timers spread through a stall,
+and the NodeHost gauge export (ISSUE 2 tentpole, ROADMAP seed flake)."""
+import time
+
+import numpy as np
+
+from dragonboat_tpu.engine.fairness import FairnessWatchdog, peer_count
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_starvation_gauge_tracks_inter_iteration_gap():
+    clock = FakeClock()
+    wd = FairnessWatchdog("a", tick_period_s=0.005, clock=clock)
+    try:
+        t0 = wd.iter_begin()
+        clock.t += 0.004
+        wd.iter_end(t0)
+        assert wd.stats()["starvation_ratio"] < 1.0
+        # a 2-second stall: the gauge spikes to gap / tick_period
+        t0 = wd.iter_begin()
+        clock.t += 2.0
+        wd.iter_end(t0)
+        s = wd.stats()
+        assert s["max_gap_s"] >= 2.0
+        assert s["starvation_ratio"] >= 2.0 / 0.005 - 1
+        # the windowed max keeps the stall visible on later fast iters
+        for _ in range(10):
+            t0 = wd.iter_begin()
+            clock.t += 0.001
+            wd.iter_end(t0)
+        assert wd.stats()["starvation_ratio"] > 100
+    finally:
+        wd.close()
+
+
+def test_yield_enforced_only_when_a_peer_starved():
+    clock = FakeClock()
+    a = FairnessWatchdog("a", 0.005, yield_s=1e-4, clock=clock)
+    b = FairnessWatchdog("b", 0.005, yield_s=1e-4, clock=clock)
+    try:
+        # b keeps up: its beat is fresher than a's iteration start
+        t0 = a.iter_begin()
+        clock.t += 0.5  # long step for a...
+        b.iter_end(b.iter_begin())  # ...but b ran meanwhile
+        assert not a.iter_end(t0)
+        assert a.stats()["fairness_yields"] == 0
+        # b starves: no beat since before a's long iteration began
+        clock.t += 0.001
+        t0 = a.iter_begin()
+        clock.t += 0.5
+        assert a.iter_end(t0)  # yield enforced
+        assert a.stats()["fairness_yields"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_no_yield_without_peers_or_below_threshold():
+    clock = FakeClock()
+    a = FairnessWatchdog("solo", 0.005, yield_s=1e-4, clock=clock)
+    try:
+        t0 = a.iter_begin()
+        clock.t += 5.0
+        assert not a.iter_end(t0)  # nobody to be fair to
+    finally:
+        a.close()
+    clock2 = FakeClock()
+    c = FairnessWatchdog("c", 0.005, yield_s=1e-4, clock=clock2)
+    d = FairnessWatchdog("d", 0.005, yield_s=1e-4, clock=clock2)
+    try:
+        t0 = c.iter_begin()
+        clock2.t += 0.001  # fast iteration: below the yield threshold
+        assert not c.iter_end(t0)
+    finally:
+        c.close()
+        d.close()
+
+
+def test_closed_watchdog_leaves_registry():
+    n0 = peer_count()
+    wd = FairnessWatchdog("tmp", 0.005)
+    assert peer_count() == n0 + 1
+    wd.close()
+    assert peer_count() == n0
+    wd.close()  # idempotent
+
+
+def test_tick_burst_clamp_preserves_election_spread():
+    """The engine-level invariant behind the seed-flake fix: the per-lane
+    tick replay cap must stay BELOW the election RTT, so a coalesced
+    backlog cannot cross rand_timeout ∈ [et, 2et) for every lane in the
+    same step."""
+    from dragonboat_tpu.config import Config, NodeHostConfig, EngineConfig
+    from dragonboat_tpu.engine.vector import VectorEngine
+    from dragonboat_tpu.storage.logdb import ShardedLogDB
+
+    cfg = NodeHostConfig(
+        rtt_millisecond=5,
+        raft_address="wd:1",
+        engine=EngineConfig(max_groups=8, max_peers=4, log_window=32),
+    )
+    eng = VectorEngine(ShardedLogDB(), nh_config=cfg)
+    try:
+        # simulate what _compute_activation writes for a lane with the
+        # default test timings (election_rtt=20, heartbeat_rtt=4)
+        assert eng._catchup_tick_cap == 0  # auto
+        # auto clamp = heartbeat RTT, far below the election RTT
+        g = 0
+        hb, et = 4, 20
+        burst = eng._catchup_tick_cap or hb
+        eng._m_tick_cap[g] = max(1, min(et, burst))
+        assert int(eng._m_tick_cap[g]) == 4
+        # a 2-second stall backlog (400 ticks at 5ms) replays at <= 4 per
+        # step: reaching even the minimum rand_timeout takes >= 5 steps,
+        # so per-lane randomization (spread over [et, 2et)) still
+        # staggers campaigns across steps
+        backlog = 400
+        per_step = int(np.minimum(eng._m_tick_cap[g], backlog))
+        assert per_step * 2 < et  # two post-stall steps cannot expire it
+        assert eng.fairness_stats()["tick_period_s"] == 0.005
+    finally:
+        eng.stop()
+
+
+def test_nodehost_exports_starvation_gauges():
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.statemachine import IStateMachine, Result
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+    class SM(IStateMachine):
+        def update(self, data):
+            return Result(value=1)
+
+        def lookup(self, q):
+            return None
+
+        def save_snapshot(self, w, files, done):
+            w.write(b"{}")
+
+        def recover_from_snapshot(self, r, files, done):
+            pass
+
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=1,
+            rtt_millisecond=5,
+            raft_address="wdx:1",
+            raft_rpc_factory=lambda l: loopback_factory(l, reg),
+            engine=EngineConfig(
+                kind="vector", max_groups=8, max_peers=4, log_window=32
+            ),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "wdx:1"},
+            False,
+            lambda c, n: SM(),
+            Config(cluster_id=7, node_id=1, election_rtt=10, heartbeat_rtt=2),
+        )
+        deadline = time.monotonic() + 5
+        key = (0, 0)
+        while time.monotonic() < deadline:
+            if nh.metrics.gauge_value(
+                "engine_tick_starvation_ratio", key
+            ) is not None:
+                break
+            time.sleep(0.05)
+        assert nh.metrics.gauge_value(
+            "engine_tick_starvation_ratio", key
+        ) is not None
+        assert nh.metrics.gauge_value("transport_breakers_open", key) == 0.0
+        # the Prometheus exposition carries them too
+        import io
+
+        buf = io.StringIO()
+        nh.write_health_metrics(buf)
+        text = buf.getvalue()
+        assert "engine_tick_starvation_ratio" in text
+    finally:
+        nh.stop()

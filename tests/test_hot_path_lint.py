@@ -1,4 +1,4 @@
-"""Static guard over the vector engine's step hot path.
+"""Static guard over the engine's and transport's step hot paths.
 
 The columnar host fan-out replaced per-(group, peer) Python — per-element
 `int(arr[g, p])` reads, `.item()` calls and `.tolist()` conversions inside
@@ -16,6 +16,12 @@ them):
   * no `int(x[...])` scalar conversions of subscripted values inside a
     for/while body (a per-element device-mirror read).
 
+The transport's send path (HOT_LOCK_FUNCTIONS) has its own banned
+pattern: no `with <lock>` acquisition inside a for/while body. The bulk
+seam exists so one queue lock + one breaker check covers a whole target
+batch (_SendQueue.put_many / Transport.send_many); a per-message lock
+acquisition silently reintroduces O(messages) synchronization per step.
+
 Slow paths (catchup, snapshot feedback, reconciles, rebase, `_maintain`)
 are intentionally NOT listed: they run on rare lanes and may use
 per-element access. A genuinely unavoidable exception inside a hot
@@ -28,6 +34,7 @@ import ast
 import inspect
 
 import dragonboat_tpu.engine.vector as vector
+import dragonboat_tpu.transport.transport as transport
 
 # the step hot path: every function here runs once per engine step on the
 # loop thread (pack -> dispatch -> fetch -> decode/fan-out -> save)
@@ -48,11 +55,18 @@ HOT_FUNCTIONS = [
     (None, "build_save_updates"),
 ]
 
+# the transport send hot path: one lock/breaker-check per TARGET BATCH,
+# never per message (the send-queue prioritization must stay amortized)
+HOT_LOCK_FUNCTIONS = [
+    (transport, "Transport", "send_many"),
+    (transport, "_SendQueue", "put_many"),
+]
+
 WHITELIST_MARK = "hot-path: ok"
 
 
-def _resolve(cls_name, fn_name):
-    obj = vector if cls_name is None else getattr(vector, cls_name)
+def _resolve(cls_name, fn_name, module=vector):
+    obj = module if cls_name is None else getattr(module, cls_name)
     return getattr(obj, fn_name)
 
 
@@ -114,6 +128,27 @@ def _violations_in(fn_node, src_lines, first_lineno, fn_label):
     return out
 
 
+def _lock_violations_in(fn_node, src_lines, first_lineno, fn_label):
+    """Flag `with <anything>` inside a for/while body: in the transport's
+    bulk send functions every lock acquisition must cover the whole batch,
+    so no with-statement belongs inside a per-message loop."""
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for stmt in node.body + node.orelse:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.With):
+                    line = src_lines[sub.lineno - 1]
+                    if WHITELIST_MARK not in line:
+                        out.append(
+                            f"{fn_label}:{first_lineno + sub.lineno - 1}: "
+                            f"lock acquisition inside a per-message loop: "
+                            f"{line.strip()}"
+                        )
+    return out
+
+
 def test_hot_path_stays_columnar():
     problems = []
     for cls_name, fn_name in HOT_FUNCTIONS:
@@ -131,6 +166,43 @@ def test_hot_path_stays_columnar():
             _violations_in(fn_node, src_lines, first_lineno, label)
         )
     assert not problems, "\n".join(problems)
+
+
+def test_transport_send_path_amortizes_locks():
+    problems = []
+    for module, cls_name, fn_name in HOT_LOCK_FUNCTIONS:
+        label = f"{cls_name + '.' if cls_name else ''}{fn_name}"
+        try:
+            fn = _resolve(cls_name, fn_name, module)
+        except AttributeError:
+            problems.append(
+                f"{label}: hot function no longer exists — update the "
+                f"HOT_LOCK_FUNCTIONS list (and keep its replacement "
+                f"batch-amortized)"
+            )
+            continue
+        fn_node, (src_lines, first_lineno) = _function_ast(fn)
+        problems.extend(
+            _lock_violations_in(fn_node, src_lines, first_lineno, label)
+        )
+    assert not problems, "\n".join(problems)
+
+
+def test_lock_lint_catches_regressions():
+    bad_src = (
+        "def f(self, msgs):\n"
+        "    n = 0\n"
+        "    for m in msgs:\n"
+        "        with self._cv:\n"  # per-message lock: BANNED
+        "            n += 1\n"
+        "    with self._cv:\n"  # batch-level lock outside the loop: fine
+        "        pass\n"
+        "    return n\n"
+    )
+    tree = ast.parse(bad_src)
+    lines = bad_src.split("\n")
+    got = _lock_violations_in(tree.body[0], lines, 1, "f")
+    assert len(got) == 1, got
 
 
 def test_lint_catches_regressions():

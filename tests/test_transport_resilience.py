@@ -1,0 +1,244 @@
+"""Transport resilience tests: breaker backoff growth, jittered
+cooldowns, half-open probe success/failure, and heartbeat-over-bulk
+priority/eviction order in the send queue (ISSUE 2 satellite)."""
+import random
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu.transport.transport import (
+    URGENT_TYPES,
+    _Breaker,
+    _SendQueue,
+    Transport,
+)
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+from dragonboat_tpu.types import Entry, Message, MessageType
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def mk_breaker(**kw):
+    clock = FakeClock()
+    b = _Breaker(
+        base_cooldown=0.5,
+        max_cooldown=8.0,
+        jitter=0.25,
+        rng=random.Random(7),
+        clock=clock,
+        **kw,
+    )
+    return b, clock
+
+
+# --------------------------------------------------------------- breaker
+def test_breaker_backoff_growth_and_jitter():
+    b, clock = mk_breaker()
+    nominals = []
+    cooldowns = []
+    for _ in range(6):
+        b.fail()
+        snap = b.snapshot()
+        nominals.append(snap["nominal_cooldown_s"])
+        cooldowns.append(snap["cooldown_s"])
+        clock.advance(snap["cooldown_s"] + 0.01)
+        assert b.allow_probe()  # half-open: probe granted, then fails again
+    # nominal cooldown doubles per reopen up to the cap
+    assert nominals == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]
+    # actual cooldowns are jittered within ±25% of nominal, and not all
+    # equal to nominal (the jitter is real)
+    for nom, cd in zip(nominals, cooldowns):
+        assert 0.75 * nom <= cd <= 1.25 * nom
+    assert any(abs(cd - nom) > 1e-6 for nom, cd in zip(nominals, cooldowns))
+
+
+def test_breaker_half_open_single_probe_then_close():
+    b, clock = mk_breaker()
+    b.fail()
+    assert b.is_open()
+    assert not b.allow_probe()  # still cooling
+    assert not b.allow_enqueue()
+    clock.advance(b.snapshot()["cooldown_s"] + 0.01)
+    assert b.allow_enqueue()  # half-open window admits traffic
+    assert b.allow_probe()  # exactly ONE probe
+    assert not b.allow_probe()  # concurrent probe refused
+    b.success()
+    assert not b.is_open()
+    assert b.allow_probe()  # closed again: all traffic flows
+    assert b.snapshot()["nominal_cooldown_s"] == 0.5  # backoff reset
+
+
+def test_breaker_probe_failure_reopens_with_doubled_cooldown():
+    b, clock = mk_breaker()
+    b.fail()
+    cd1 = b.snapshot()["cooldown_s"]
+    clock.advance(cd1 + 0.01)
+    assert b.allow_probe()
+    b.fail()  # probe failed
+    snap = b.snapshot()
+    assert snap["state"] == "open"
+    assert snap["nominal_cooldown_s"] == 1.0
+    assert snap["probe_failures"] == 1
+    assert not b.allow_probe()  # cooling again, from the failure time
+
+
+# ------------------------------------------------------------ send queue
+def hb(to=2):
+    return Message(type=MessageType.HEARTBEAT, cluster_id=1, to=to, from_=1)
+
+
+def vote(to=2):
+    return Message(type=MessageType.REQUEST_VOTE, cluster_id=1, to=to, from_=1)
+
+
+def bulk(i=0):
+    return Message(
+        type=MessageType.REPLICATE,
+        cluster_id=1,
+        to=2,
+        from_=1,
+        entries=[Entry(index=i + 1, term=1, cmd=b"x" * 32)],
+    )
+
+
+def drain(sq):
+    out = []
+    while True:
+        m = sq.get_nowait()
+        if m is None:
+            return out
+        out.append(m)
+
+
+def test_urgent_pops_before_bulk():
+    sq = _SendQueue(16)
+    assert sq.try_put(bulk(0))
+    assert sq.try_put(bulk(1))
+    assert sq.try_put(hb())
+    assert sq.try_put(vote())
+    got = drain(sq)
+    assert [m.type for m in got] == [
+        MessageType.HEARTBEAT,
+        MessageType.REQUEST_VOTE,
+        MessageType.REPLICATE,
+        MessageType.REPLICATE,
+    ]
+    # relative order within each class is preserved
+    assert [m.entries[0].index for m in got[2:]] == [1, 2]
+
+
+def test_full_queue_urgent_evicts_oldest_bulk():
+    sq = _SendQueue(3)
+    for i in range(3):
+        assert sq.try_put(bulk(i))
+    assert sq.try_put(hb())  # queue full: evicts bulk(0)
+    assert sq.evicted_bulk == 1
+    assert sq.dropped_urgent == 0
+    got = drain(sq)
+    assert got[0].type == MessageType.HEARTBEAT
+    assert [m.entries[0].index for m in got[1:]] == [2, 3]
+
+
+def test_full_queue_bulk_is_dropped_not_urgent():
+    sq = _SendQueue(2)
+    assert sq.try_put(bulk(0))
+    assert sq.try_put(bulk(1))
+    assert not sq.try_put(bulk(2))  # bulk refused at full
+    assert sq.dropped_bulk == 1
+    assert sq.try_put(hb())  # urgent still admitted (evicts)
+    assert sq.dropped_urgent == 0
+
+
+def test_urgent_exempt_from_byte_backpressure():
+    # tiny byte budget: bulk is rate-limited out, heartbeats still flow
+    sq = _SendQueue(64, max_bytes=100)
+    assert sq.try_put(bulk(0))
+    assert not sq.try_put(bulk(1))  # over the byte budget
+    assert sq.try_put(hb())
+    assert sq.try_put(vote())
+    assert sq.dropped_bulk == 1
+    assert sq.dropped_urgent == 0
+
+
+def test_put_many_counts_and_wakes_once():
+    sq = _SendQueue(4)
+    msgs = [bulk(0), hb(), bulk(1), bulk(2), bulk(3)]  # one over capacity
+    assert sq.put_many(msgs) == 4
+    assert sq.dropped_bulk == 1
+    got = drain(sq)
+    assert got[0].type == MessageType.HEARTBEAT
+
+
+def test_urgent_types_cover_the_control_plane():
+    assert MessageType.HEARTBEAT in URGENT_TYPES
+    assert MessageType.HEARTBEAT_RESP in URGENT_TYPES
+    assert MessageType.REQUEST_VOTE in URGENT_TYPES
+    assert MessageType.REQUEST_VOTE_RESP in URGENT_TYPES
+    assert MessageType.TIMEOUT_NOW in URGENT_TYPES
+    assert MessageType.REPLICATE not in URGENT_TYPES
+
+
+# --------------------------------------------- end-to-end breaker recovery
+class CollectingHandler:
+    def __init__(self):
+        self.batches = []
+        self.unreachable = []
+
+    def handle_message_batch(self, batch):
+        self.batches.append(batch)
+        return 0, len(batch.requests)
+
+    def handle_unreachable(self, cluster_id, node_id):
+        self.unreachable.append((cluster_id, node_id))
+
+    def handle_snapshot_status(self, *a):
+        pass
+
+    def handle_snapshot(self, *a):
+        pass
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_transport_breaker_metrics_and_recovery():
+    reg = _Registry()
+    ha, hb_ = CollectingHandler(), CollectingHandler()
+    ta = Transport("hostA:1", 7, loopback_factory("hostA:1", reg))
+    tb = Transport("hostB:2", 7, loopback_factory("hostB:2", reg))
+    ta.set_message_handler(ha)
+    tb.set_message_handler(hb_)
+    ta.start()
+    tb.start()
+    try:
+        ta.nodes.add_node(1, 2, "hostB:2")
+        ta.rpc.blocked = True
+        ta.send(bulk(0))
+        assert wait_for(lambda: ta.metrics()["breakers_open"] == 1)
+        assert ta.metrics()["breaker_opens"] >= 1
+        states = ta.breaker_states()
+        assert states["hostB:2"]["state"] == "open"
+        ta.rpc.blocked = False
+        # within a few cooldowns the half-open probe closes the breaker
+        assert wait_for(lambda: ta.send(hb()) and hb_.batches, timeout=8)
+        assert wait_for(lambda: ta.metrics()["breakers_open"] == 0, timeout=8)
+        assert ta.breaker_states()["hostB:2"]["probes"] >= 1
+    finally:
+        ta.stop()
+        tb.stop()
