@@ -469,6 +469,42 @@ def _bench_e2e_body(
         out["membership_changes"] = churn_state["membership"]
     if host_stages:
         out.update(host_stages)
+    out.update(_latency_report(hosts))
+    return out
+
+
+def _latency_report(hosts) -> dict:
+    """Proposal-lifecycle latency percentiles from the hosts' sampled
+    histograms (EngineConfig.profile_sample_ratio=1 in the bench config:
+    one sampled proposal per submitted wave), merged across hosts into one
+    distribution per metric. The commit-latency keys are ALWAYS present —
+    0.0 when no sample landed — so the BENCH JSON schema is stable for
+    every ladder config."""
+    from dragonboat_tpu.events import Histogram
+
+    def merged(name: str) -> Histogram:
+        agg = Histogram()
+        for nh in hosts.values():
+            m = getattr(nh, "metrics", None)
+            if m is None:
+                continue
+            for h in m.histograms(name):
+                agg.merge(h)
+        return agg
+
+    commit = merged("proposal_commit_latency_seconds")
+    apply_ = merged("proposal_apply_latency_seconds")
+    fsync = merged("fsync_latency_seconds")
+    out = {
+        "commit_latency_p50_s": round(commit.quantile(0.5), 6),
+        "commit_latency_p99_s": round(commit.quantile(0.99), 6),
+        "commit_latency_samples": commit.count,
+        "apply_latency_p99_s": round(apply_.quantile(0.99), 6),
+        "fsync_latency_p99_s": round(fsync.quantile(0.99), 6),
+    }
+    reads = merged("readindex_latency_seconds")
+    if reads.count:
+        out["readindex_latency_p99_s"] = round(reads.quantile(0.99), 6)
     return out
 
 

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..logger import get_logger
 from ..settings import hard, soft
-from ..trace import Profiler
+from ..trace import LatencySampler, Profiler
 from ..types import Update
 from ..rsm.manager import From as OffloadFrom
 from .fairness import FairnessWatchdog
@@ -142,6 +142,11 @@ class ExecEngine:
         self.profilers = (
             [Profiler(ratio) for _ in range(self._n_step)] if ratio > 0 else []
         )
+        # request-lifecycle latency sampling (see trace.LatencySampler):
+        # same contract as the vector engine — a disabled stage profiler
+        # still leaves the sparse 1-in-32 request sampler on, so latency
+        # histograms exist in production without stage-timing overhead
+        self.request_sampler = LatencySampler(ratio if ratio > 0 else 32)
         self._threads: List[threading.Thread] = []
         for i in range(self._n_step):
             t = threading.Thread(

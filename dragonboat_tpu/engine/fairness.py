@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..trace import flight_recorder
+
 _peers_mu = threading.Lock()
 _peers: List["FairnessWatchdog"] = []
 
@@ -133,12 +135,17 @@ class FairnessWatchdog:
         # cede proportionally to how long we hogged the core, bounded so a
         # pathological multi-second step never parks the loop for long
         pause = self._yield_s or min(0.02, max(0.001, dur * 0.05))
+        flight_recorder().record(
+            "fairness_yield", loop=self.name, iter_s=round(dur, 6),
+            pause_s=round(pause, 6),
+        )
         time.sleep(pause)
         return True
 
     def tick_burst_clamped(self) -> None:
         """A coalesced tick backlog exceeded the per-step replay clamp."""
         self._tick_bursts_clamped += 1
+        flight_recorder().record("tick_burst_clamped", loop=self.name)
 
     # a peer whose beat is older than this is abandoned (an engine that
     # was never stop()ed), not starved: yielding to it helps nobody and

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Callable, List, Optional
 
 from ..client import Session
@@ -56,6 +57,7 @@ from ..types import (
     Snapshot,
     Update,
 )
+from ..trace import LatencyTrace
 from .quiesce import QuiesceManager
 from .queue import EntryQueue, MessageQueue, ReadIndexQueue
 from .snapshotstate import SnapshotState
@@ -104,6 +106,11 @@ class Node:
         self._batches: dict = {}  # batch_id -> BatchRequestState
         self._batch_seq = 0
         self.mq = MessageQueue(soft.received_message_queue_length)
+        # sampled request-latency seam (see trace.LatencySampler): the
+        # engine owns the sampler so every group on it shares one ratio
+        # (EngineConfig.profile_sample_ratio); unsampled requests pay one
+        # increment and allocate nothing
+        self._req_sampler = getattr(engine, "request_sampler", None)
         self.quiesce_mgr = QuiesceManager(
             enabled=cfg.quiesce, election_tick=cfg.election_rtt
         )
@@ -171,7 +178,49 @@ class Node:
     def node_ready(self) -> None:
         self.engine.set_node_ready(self.cluster_id)
 
+    # -------------------------------------------------- latency observation
+    def _metrics_registry(self):
+        ev = self.events
+        return getattr(ev, "metrics", None) if ev is not None else None
+
+    def _observe_entry_latency(self, lt: LatencyTrace) -> None:
+        """A sampled proposal finished its apply: fold the lifecycle into
+        the proposing node's latency histograms. Owner-pinned (co-hosted
+        replicas apply the identical Entry objects) and once-only."""
+        if lt.owner is not self or lt.done:
+            return
+        lt.done = True
+        m = self._metrics_registry()
+        if m is None:
+            return
+        now = time.monotonic()
+        key = (self.cluster_id, self._node_id)
+        # a missing commit stamp (engine variant without one) degrades to
+        # commit==apply rather than dropping the sample
+        commit_t = lt.t_commit or now
+        m.observe(
+            "proposal_commit_latency_seconds", key, max(commit_t - lt.t0, 0.0)
+        )
+        m.observe(
+            "proposal_apply_latency_seconds", key, max(now - lt.t0, 0.0)
+        )
+
+    def _read_latency_done(self, rs: RequestState) -> None:
+        t0 = rs.lat
+        r = rs.result
+        if t0 is None or r is None or not r.completed:
+            return  # timed-out/dropped reads are not read latencies
+        m = self._metrics_registry()
+        if m is not None:
+            m.observe(
+                "readindex_latency_seconds",
+                (self.cluster_id, self._node_id),
+                max(time.monotonic() - t0, 0.0),
+            )
+
     def apply_update(self, entry, result, rejected, ignored, notify_read) -> None:
+        if entry.lat is not None:
+            self._observe_entry_latency(entry.lat)
         if entry.key & BATCH_KEY_BIT:
             self._batch_applied(batch_id_of(entry.key), 1)
         else:
@@ -192,11 +241,15 @@ class Node:
             return  # replica apply with no locally-tracked batches
         if results is None:
             for e in entries:
+                if e.lat is not None:
+                    self._observe_entry_latency(e.lat)
                 if e.key & BATCH_KEY_BIT:
                     bid = batch_id_of(e.key)
                     counts[bid] = counts.get(bid, 0) + 1
         else:
             for e, r in zip(entries, results):
+                if e.lat is not None:
+                    self._observe_entry_latency(e.lat)
                 if e.key & BATCH_KEY_BIT:
                     bid = batch_id_of(e.key)
                     counts[bid] = counts.get(bid, 0) + 1
@@ -258,6 +311,11 @@ class Node:
             # handleProposals + requests.go ErrSystemBusy)
             raise ErrSystemBusy()
         rs, entry = self.pending_proposals.propose(session, cmd, timeout_ticks)
+        s = self._req_sampler
+        if s is not None and s.sample():
+            # propose-enqueue timestamp; the trace rides the Entry through
+            # arena -> commit -> apply and back to the histograms
+            entry.lat = LatencyTrace(self, time.monotonic())
         # optional payload compression at the propose boundary: the wire,
         # logdb and apply queue all carry the compressed form; replicas
         # decompress once at apply time (cf. rsm/encoded.go:47-176)
@@ -290,6 +348,11 @@ class Node:
         rss, entries = self.pending_proposals.propose_batch(
             session, cmds, timeout_ticks
         )
+        s = self._req_sampler
+        if entries and s is not None and s.sample():
+            # one sampled entry per batch keeps the sampler's 1-in-N
+            # meaning "1-in-N submissions", not "N samples per wave"
+            entries[-1].lat = LatencyTrace(self, time.monotonic())
         for entry in entries:
             maybe_encode_entry(self.config.entry_compression_type, entry)
         accepted = self.incoming_proposals.add_many(entries)
@@ -340,6 +403,9 @@ class Node:
             )
             for i, cmd in enumerate(cmds)
         ]
+        s = self._req_sampler
+        if entries and s is not None and s.sample():
+            entries[-1].lat = LatencyTrace(self, time.monotonic())
         if self.config.entry_compression_type:
             for entry in entries:
                 maybe_encode_entry(self.config.entry_compression_type, entry)
@@ -365,6 +431,10 @@ class Node:
 
     def read(self, timeout_ticks: int) -> RequestState:
         rs = self.pending_read_indexes.read(timeout_ticks)
+        s = self._req_sampler
+        if s is not None and s.sample():
+            rs.lat = time.monotonic()
+            rs.on_complete(self._read_latency_done)
         if not self.incoming_reads.add(rs):
             raise ErrSystemBusy()
         self.engine.set_node_ready(self.cluster_id)
@@ -560,6 +630,13 @@ class Node:
             self._push_install_snapshot(ud.snapshot)
         if not ud.committed_entries:
             return
+        now = 0.0
+        for e in ud.committed_entries:
+            lt = e.lat
+            if lt is not None and lt.t_commit == 0.0:
+                if not now:
+                    now = time.monotonic()
+                lt.t_commit = now  # quorum commit observed (sampled entry)
         self.sm.task_queue.add(
             Task(
                 cluster_id=self.cluster_id,

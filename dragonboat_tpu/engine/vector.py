@@ -71,6 +71,7 @@ carries real node ids and real (un-rebased) indexes.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -102,7 +103,7 @@ from ..ops.state import (
 from ..requests import LogicalClock
 from ..settings import soft
 from ..storage.kv import sync_all as _kv_sync_all
-from ..trace import Profiler
+from ..trace import LatencySampler, Profiler
 from ..types import (
     Entry,
     EntryType,
@@ -889,6 +890,25 @@ class VectorEngine:
         # EngineConfig.profile_sample_ratio=1.
         ratio = (getattr(ecfg, "profile_sample_ratio", 0) or 0) if ecfg else 0
         self.profiler = Profiler(sample_ratio=ratio if ratio > 0 else 32)
+        # request-lifecycle latency sampling shares the profiler's ratio
+        # knob: 1-in-N proposals/reads carry a LatencyTrace into the
+        # proposal_commit/apply and readindex latency histograms; the
+        # other N-1 stay allocation-free (see trace.LatencySampler)
+        self.request_sampler = LatencySampler(ratio if ratio > 0 else 32)
+        # per-step counters accumulated inline by the decode phases on
+        # objects they already materialize (no extra device syncs, no
+        # extra numpy reductions); exported via step_stats() and folded
+        # into NodeHost._export_health_gauges as engine_step_* gauges
+        self._sstats = {
+            "steps": 0,
+            "msgs_replicate": 0,  # phase-1 Replicate messages out
+            "msgs_broadcast": 0,  # phase-3 votes/heartbeats/TimeoutNow out
+            "msgs_resp": 0,  # phase-3 response-plane messages out
+            "lanes_commit_advanced": 0,  # lanes handing commits to the RSM
+            "leader_changes": 0,  # (leader, term) transitions observed
+            "elections_started": 0,  # lanes that went leaderless
+            "entries_applied": 0,  # entries handed to the RSM
+        }
         # ---- tick-fairness watchdog (ROADMAP seed flake) -----------------
         # Inter-iteration latency vs the host's tick period, a starvation
         # gauge, and an enforced yield when a long kernel step starved a
@@ -1954,6 +1974,12 @@ class VectorEngine:
                     term=noop_term,
                     index=b + noop_at,
                 )
+        # ---- per-step stats: steps counter (the rest accumulates inline
+        # on objects each phase already materializes — len() of the send
+        # batches, counts inside loops that already run — so the stats
+        # plane adds ZERO numpy reductions to the step)
+        st = self._sstats
+        st["steps"] += 1
         # ---- mirror refresh + leader-change events -----------------------
         new_leader = o["leader"]
         new_term = o["term"]
@@ -1970,6 +1996,7 @@ class VectorEngine:
         self._m_commit = o["commit_index"].astype(np.int64)
         self._m_last = o["last_index"].astype(np.int64)
         if changed.size:
+            lead_n = elect_n = 0
             for g, lslot, term in zip(
                 changed.tolist(),
                 new_leader[changed].tolist(),
@@ -1978,13 +2005,21 @@ class VectorEngine:
                 lane = lane_by_g[g]
                 if lane is None or not lane.active:
                     continue
+                lead_n += 1
+                if lslot == 0:
+                    # lane went leaderless: an election is underway
+                    elect_n += 1
                 lane.node._leader_event(lane.rev.get(lslot - 1, 0), term)
+            st["leader_changes"] += lead_n
+            st["elections_started"] += elect_n
         prof.end("place")
         # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
         prof.start()
-        self._dispatch_sends(
-            gather_replicate_sends(o, base, lane_by_g, self._fetch_from_log)
+        rep_sends = gather_replicate_sends(
+            o, base, lane_by_g, self._fetch_from_log
         )
+        st["msgs_replicate"] += len(rep_sends)
+        self._dispatch_sends(rep_sends)
         prof.end("send_rep")
         # ---- phase 2: one batched fsynced write for every lane -----------
         prof.start()
@@ -1999,7 +2034,10 @@ class VectorEngine:
         # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
         prof.start()
         post = gather_post_sends(o, base, lane_by_g)
-        post.extend(gather_resp_sends(o, base, lane_by_g))
+        st["msgs_broadcast"] += len(post)
+        resp_sends = gather_resp_sends(o, base, lane_by_g)
+        st["msgs_resp"] += len(resp_sends)
+        post.extend(resp_sends)
         self._dispatch_sends(post)
         # snapshot path for peers that fell behind the device window
         snap_gs, snap_ps = np.nonzero(o["send_flags"] & NEED_SNAPSHOT)
@@ -2015,6 +2053,8 @@ class VectorEngine:
 
         apply_gs = np.nonzero(o["apply_from"])[0]
         if apply_gs.size:
+            applied_n = lanes_n = 0
+            t_commit = time.monotonic()  # one clock read for the step
             for g, b, af, at in zip(
                 apply_gs.tolist(),
                 base[apply_gs].tolist(),
@@ -2046,11 +2086,23 @@ class VectorEngine:
                     )
                 )
                 self._m_applied_since[g] += len(ents)
+                applied_n += len(ents)
+                lanes_n += 1  # this lane really handed work to the RSM
                 # committed + dispatched to the RSM: no longer mem pressure
                 lane.arena.mark_applied(b + at)
-                if any(e.type == EntryType.CONFIG_CHANGE for e in ents):
+                has_cc = False
+                for e in ents:
+                    if e.type == EntryType.CONFIG_CHANGE:
+                        has_cc = True
+                    lt = e.lat
+                    if lt is not None and lt.t_commit == 0.0:
+                        # sampled proposal reached quorum commit this step
+                        lt.t_commit = t_commit
+                if has_cc:
                     lane.cc_inflight = False
                 self.set_task_ready(lane.key)
+            st["entries_applied"] += applied_n
+            st["lanes_commit_advanced"] += lanes_n
         # ---- phase 5: confirmed reads ------------------------------------
         rc = o["ready_count"]
         ready_gs = np.nonzero(rc)[0]
@@ -3096,6 +3148,13 @@ class VectorEngine:
         """Tick-fairness watchdog snapshot: inter-iteration latency vs the
         tick period, the starvation gauge, burst clamps, enforced yields."""
         return self.watchdog.stats()
+
+    def step_stats(self) -> dict:
+        """Cumulative per-step columnar counters (kernel steps, outbound
+        messages by plane, lanes with commit advance, elections started,
+        entries handed to the RSM) — derived host-side from the decoded
+        StepOutput, so reading them costs nothing on the device."""
+        return dict(self._sstats)
 
     def leader_snapshot(self) -> Dict[tuple, Tuple[int, int]]:
         """One vectorized pass over the numpy mirrors: lane key ->

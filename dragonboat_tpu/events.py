@@ -6,25 +6,112 @@ Prometheus text exposition format (WriteHealthMetrics event.go:30-32) and
 (b) the user's IRaftEventListener (LeaderUpdated via a dedicated queue —
 nodehost.go:1686-1701; here the user callback runs on a single dispatcher
 thread so a slow listener can't stall step workers).
+
+The registry also carries the observability plane's latency histograms
+(log-bucketed, Prometheus `_bucket`/`_sum`/`_count` exposition): the
+proposal lifecycle (propose-enqueue -> quorum commit -> apply/notify),
+linearizable reads, and the WAL fsync barrier.
 """
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .raftio import IRaftEventListener, LeaderInfo
+from .trace import flight_recorder
 
 _LabelKey = Tuple[int, int]  # (cluster_id, node_id)
 
 
+# log-bucketed latency bounds in seconds: powers of two from ~15us to
+# ~131s (24 buckets + overflow). Log spacing keeps p50/p99 estimation
+# error bounded at a constant relative factor across six decades — the
+# proposal path spans sub-ms co-hosted commits to multi-second chaos
+# stalls on one scale.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    2.0**e for e in range(-16, 8)
+)
+
+
+class Histogram:
+    """Log-bucketed histogram with Prometheus semantics.
+
+    observe() is bucket-increment + two adds under one small lock — no
+    allocation, so sampled hot-path observation stays cheap. Bucket counts
+    are NON-cumulative internally; exposition writes the cumulative
+    `_bucket{le=...}` / `_sum` / `_count` triplet."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "_mu")
+
+    def __init__(
+        self, bounds: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+    ) -> None:
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 overflow (+Inf)
+        self.sum = 0.0
+        self.count = 0
+        self._mu = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.bounds, v)
+        with self._mu:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram with identical bounds into this one
+        (bench aggregates per-host histograms into one distribution)."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bounds mismatch")
+        with other._mu:
+            counts = list(other.counts)
+            s, c = other.sum, other.count
+        with self._mu:
+            for i, n in enumerate(counts):
+                self.counts[i] += n
+            self.sum += s
+            self.count += c
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (0 <= q <= 1)."""
+        with self._mu:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(counts[:-1]):
+            if c and cum + c >= target:
+                frac = (target - cum) / c
+                hi = self.bounds[i]
+                return lo + (hi - lo) * frac
+            cum += c
+            lo = self.bounds[i]
+        return self.bounds[-1]  # landed in the +Inf overflow bucket
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._mu:
+            return list(self.counts), self.sum, self.count
+
+
+def _labels(pairs) -> str:
+    """Prometheus label block with SORTED label keys."""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in sorted(pairs)) + "}"
+
+
 class MetricsRegistry:
-    """Minimal counter/gauge registry with Prometheus text exposition."""
+    """Counter/gauge/histogram registry with Prometheus text exposition."""
 
     def __init__(self, prefix: str = "dragonboat_tpu") -> None:
         self._prefix = prefix
         self._mu = threading.Lock()
         self._counters: Dict[str, Dict[_LabelKey, float]] = {}
         self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
+        self._hists: Dict[str, Dict[_LabelKey, Histogram]] = {}
 
     def inc(self, name: str, key: _LabelKey, delta: float = 1.0) -> None:
         with self._mu:
@@ -43,8 +130,33 @@ class MetricsRegistry:
         with self._mu:
             return self._gauges.get(name, {}).get(key)
 
+    # -- histograms --------------------------------------------------------
+    def observe(self, name: str, key: _LabelKey, value: float) -> None:
+        """Record one observation into the (name, key) histogram. The
+        common case (histogram exists) costs one dict probe under the
+        registry lock plus the bucket increment."""
+        with self._mu:
+            table = self._hists.get(name)
+            if table is None:
+                table = self._hists[name] = {}
+            h = table.get(key)
+            if h is None:
+                h = table[key] = Histogram()
+        h.observe(value)
+
+    def histogram(self, name: str, key: _LabelKey) -> Optional[Histogram]:
+        with self._mu:
+            return self._hists.get(name, {}).get(key)
+
+    def histograms(self, name: str) -> List[Histogram]:
+        """Every label key's histogram for `name` (bench merges them)."""
+        with self._mu:
+            return list(self._hists.get(name, {}).values())
+
     def write(self, w) -> None:
-        """Prometheus text exposition (cf. WriteHealthMetrics event.go:30)."""
+        """Prometheus text exposition (cf. WriteHealthMetrics event.go:30).
+        One `# TYPE` line per metric family; cumulative histogram buckets
+        with a `+Inf` bucket equal to `_count`; label keys sorted."""
         with self._mu:
             for kind, table in (("counter", self._counters), ("gauge", self._gauges)):
                 for name in sorted(table):
@@ -52,8 +164,30 @@ class MetricsRegistry:
                     w.write(f"# TYPE {full} {kind}\n")
                     for (cid, nid), v in sorted(table[name].items()):
                         w.write(
-                            f'{full}{{clusterid="{cid}",nodeid="{nid}"}} {v:g}\n'
+                            f"{full}"
+                            f"{_labels((('clusterid', cid), ('nodeid', nid)))}"
+                            f" {v:g}\n"
                         )
+            for name in sorted(self._hists):
+                full = f"{self._prefix}_{name}"
+                w.write(f"# TYPE {full} histogram\n")
+                for (cid, nid), h in sorted(self._hists[name].items()):
+                    counts, total_sum, count = h.snapshot()
+                    base = (("clusterid", cid), ("nodeid", nid))
+                    cum = 0
+                    for bound, c in zip(h.bounds, counts):
+                        cum += c
+                        w.write(
+                            f"{full}_bucket"
+                            f"{_labels(base + (('le', f'{bound:g}'),))}"
+                            f" {cum}\n"
+                        )
+                    w.write(
+                        f"{full}_bucket"
+                        f"{_labels(base + (('le', '+Inf'),))} {count}\n"
+                    )
+                    w.write(f"{full}_sum{_labels(base)} {total_sum:g}\n")
+                    w.write(f"{full}_count{_labels(base)} {count}\n")
 
 
 class RaftEventAggregator:
@@ -75,6 +209,12 @@ class RaftEventAggregator:
         # the final "leader is now X" update — intermediate churn collapses.
         self._cv = threading.Condition()
         self._pending: Dict[_LabelKey, LeaderInfo] = {}
+        # last leader recorded per (cluster, node): the flight recorder
+        # logs LEADER transitions (including ->0, the gap-opening edge)
+        # but not term-only churn — bring-up election storms bump terms
+        # every step and would flood the ring exactly when the host is
+        # CPU-bound (plain dict: torn reads only cost a dup/missed event)
+        self._last_leader: Dict[_LabelKey, int] = {}
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         if user_listener is not None:
@@ -108,6 +248,22 @@ class RaftEventAggregator:
 
     # -- callbacks from the raft core (all on step-worker threads) ----------
     def leader_updated(self, cluster_id, node_id, leader_id, term) -> None:
+        # flight-recorder breadcrumb regardless of the metrics flag (a
+        # postmortem timeline without leader changes is useless). LEADER
+        # transitions only — including ->0, the availability gap's
+        # opening edge — while term-only churn is suppressed (bring-up
+        # election storms bump terms every step and would flood the ring
+        # exactly when the host is CPU-bound)
+        key = (cluster_id, node_id)
+        if self._last_leader.get(key) != leader_id:
+            self._last_leader[key] = leader_id
+            flight_recorder().record(
+                "leader_changed",
+                cluster=cluster_id,
+                node=node_id,
+                leader=leader_id,
+                term=term,
+            )
         if self._enabled:
             key = (cluster_id, node_id)
             self.metrics.set_gauge("raftnode_has_leader", key, 1.0 if leader_id else 0.0)
@@ -157,11 +313,46 @@ class RaftEventAggregator:
                 "raftnode_read_index_dropped_total", (cluster_id, node_id)
             )
 
+    # Optional event-callback vocabulary the raft core MAY grow into (cf.
+    # internal/server/event.go:75-83 raftEventListener's full surface):
+    # these resolve to a shared noop until a real handler exists. Anything
+    # else raises AttributeError — the old unconditional noop fallback
+    # masked typo'd callback names and made hasattr() probing useless
+    # (every probe answered True).
+    _OPTIONAL_CALLBACKS = frozenset(
+        {
+            "connection_established",
+            "connection_failed",
+            "membership_changed",
+            "send_snapshot_started",
+            "send_snapshot_completed",
+            "send_snapshot_aborted",
+            "snapshot_received",
+            "snapshot_recovered",
+            "snapshot_created",
+            "snapshot_compacted",
+            "log_compacted",
+            "logdb_compacted",
+        }
+    )
+
+    @staticmethod
+    def _noop(*a, **k):
+        return None
+
     def __getattr__(self, name):
-        def noop(*a, **k):
-            return None
+        if name in RaftEventAggregator._OPTIONAL_CALLBACKS:
+            return RaftEventAggregator._noop
+        raise AttributeError(
+            f"RaftEventAggregator has no event callback {name!r} "
+            f"(declared optional callbacks: sorted list in "
+            f"_OPTIONAL_CALLBACKS)"
+        )
 
-        return noop
 
-
-__all__ = ["MetricsRegistry", "RaftEventAggregator"]
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Histogram",
+    "MetricsRegistry",
+    "RaftEventAggregator",
+]

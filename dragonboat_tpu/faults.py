@@ -43,6 +43,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .storage.kv import IKVStore, WriteBatch
+from .trace import flight_recorder
 from .types import Message, MessageBatch, MessageType
 
 
@@ -128,6 +129,13 @@ class FaultPlane:
             s.n += 1
             verdict = s.rng.random() < p
         self._log_decision(site, kind, n, verdict)
+        if verdict:
+            # only FIRED faults hit the flight recorder: the timeline
+            # answers "what was injected when", not "what was rolled"
+            flight_recorder().record(
+                "fault_injected", site=site, kind=kind, n=n,
+                seed=self.seed,
+            )
         return verdict
 
     def uniform(self, site: str, kind: str, lo: float, hi: float) -> float:
@@ -305,6 +313,10 @@ class FaultPlane:
             victim = self.choice(site, "victim", victims)
             window = self.uniform(site, "window", min_window_s, max_window_s)
             idle = self.uniform(site, "idle", 0.1, 0.4)
+            flight_recorder().record(
+                "partition_window", site=site, victim=victim,
+                window_s=round(window, 4), seed=self.seed,
+            )
             yield victim, window, idle
             budget -= window + idle
 
@@ -355,6 +367,7 @@ class FaultyKV(IKVStore):
         self.inner = inner
         self.plane = plane
         self.site = site
+        self._fsync_observer = None
 
     def name(self) -> str:
         return f"faulty-{self.inner.name()}"
@@ -368,16 +381,35 @@ class FaultyKV(IKVStore):
     def iterate_value(self, fk, lk, inc_last, op) -> None:
         self.inner.iterate_value(fk, lk, inc_last, op)
 
-    def commit_write_batch(self, wb: WriteBatch) -> None:
+    def _timed_barrier(self, fn) -> None:
+        """Run one durability barrier (injected fault + the real thing)
+        under the fsync observer's clock: the histogram must see the
+        EFFECTIVE barrier latency including injected stalls, or a chaos
+        run's fsync_latency p99 would never line up with its
+        fault_injected{kind="fsync_stall"} timeline."""
+        obs = self._fsync_observer
+        if obs is None:
+            self.plane.maybe_fsync_fault(self.site)
+            fn()
+            return
+        t0 = time.monotonic()
         self.plane.maybe_fsync_fault(self.site)
-        self.inner.commit_write_batch(wb)
+        fn()
+        obs(time.monotonic() - t0)
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        self._timed_barrier(lambda: self.inner.commit_write_batch(wb))
 
     def commit_write_batch_deferred(self, wb: WriteBatch) -> bool:
         return self.inner.commit_write_batch_deferred(wb)
 
     def sync(self) -> None:
-        self.plane.maybe_fsync_fault(self.site)
-        self.inner.sync()
+        self._timed_barrier(self.inner.sync)
+
+    def set_fsync_observer(self, cb) -> None:
+        # observation stays at the WRAPPER (not forwarded to the inner
+        # store) so injected stalls are part of the measured barrier
+        self._fsync_observer = cb
 
     def bulk_remove_entries(self, fk, lk) -> None:
         self.inner.bulk_remove_entries(fk, lk)

@@ -36,6 +36,7 @@ from .requests import (
 from .rsm import SSRequest, SS_REQ_EXPORTED, SS_REQ_USER
 from .statemachine import Result, sm_type_of
 from .storage import LogReader, ShardedLogDB
+from .trace import flight_recorder
 from .transport import Transport, loopback_factory
 from .transport.tcp import tcp_factory
 from .types import (
@@ -131,6 +132,12 @@ class NodeHost(IMessageHandler):
             self.logdb = ShardedLogDB(os.path.join(self._dir, "logdb"))
         else:
             self.logdb = ShardedLogDB()  # in-memory
+        # WAL durability-barrier latency -> fsync_latency_seconds histogram
+        # (observed at every real fsync; barriers are ms-scale and the
+        # observation is two clock reads + a bucket increment)
+        set_fsync_obs = getattr(self.logdb, "set_fsync_observer", None)
+        if set_fsync_obs is not None:
+            set_fsync_obs(self._observe_fsync)
         # --- transport
         if cfg.raft_rpc_factory is not None:
             rpc_factory = cfg.raft_rpc_factory(cfg.get_listen_address())
@@ -250,6 +257,9 @@ class NodeHost(IMessageHandler):
         self._release_dir_lock()
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
+
+    def _observe_fsync(self, seconds: float) -> None:
+        self.metrics.observe("fsync_latency_seconds", (0, 0), seconds)
 
     def write_health_metrics(self, w) -> None:
         """Prometheus text exposition of node + transport metrics
@@ -569,6 +579,10 @@ class NodeHost(IMessageHandler):
         cc = ConfigChange(
             config_change_id=cc_id, type=cctype, node_id=node_id, address=address
         )
+        flight_recorder().record(
+            "config_change_requested", cluster=cluster_id,
+            kind=cctype.name, target=node_id, host=self.config.raft_address,
+        )
         if address:
             self.transport.nodes.add_node(cluster_id, node_id, address)
         return node.request_config_change(cc, self._to_ticks(timeout_s))
@@ -623,6 +637,10 @@ class NodeHost(IMessageHandler):
             path=export_path,
             override_compaction=compaction_overhead > 0,
             compaction_overhead=compaction_overhead,
+        )
+        flight_recorder().record(
+            "snapshot_requested", cluster=cluster_id,
+            exported=bool(export_path), host=self.config.raft_address,
         )
         return node.request_snapshot(req, self._to_ticks(timeout_s))
 
@@ -721,6 +739,10 @@ class NodeHost(IMessageHandler):
     def set_partitioned(self, partitioned: bool) -> None:
         """Partition mode: drop ALL inbound and outbound raft traffic
         (cf. monkey.go:169-198)."""
+        flight_recorder().record(
+            "partition_set", host=self.config.raft_address,
+            partitioned=partitioned,
+        )
         self._partitioned = partitioned
         # co-hosted delivery bypasses the transport, so the engine core
         # must drop inbound traffic for this host too
@@ -1009,6 +1031,13 @@ class NodeHost(IMessageHandler):
         ):
             if name in tm:
                 self.metrics.set_gauge(f"transport_{name}", (0, 0), tm[name])
+        # vector-engine per-step columnar counters (messages by plane,
+        # commit-advancing lanes, elections, applied entries) — derived
+        # host-side from decoded StepOutput, no device syncs to read
+        step_stats = getattr(self.engine, "step_stats", None)
+        if step_stats is not None:
+            for name, v in step_stats().items():
+                self.metrics.set_gauge(f"engine_step_{name}", (0, 0), float(v))
 
 
 __all__ = [

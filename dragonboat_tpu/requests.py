@@ -198,7 +198,7 @@ class RequestState:
     calling thread; the engine thread completes it via notify()."""
 
     __slots__ = ("key", "client_id", "series_id", "deadline", "_event",
-                 "_result", "_cb")
+                 "_result", "_cb", "lat")
 
     def __init__(self) -> None:
         self.key = 0
@@ -208,6 +208,12 @@ class RequestState:
         self._event = threading.Event()
         self._result: Optional[RequestResult] = None
         self._cb = None
+        # sampled-latency timestamp (see trace.LatencySampler): None on
+        # the unsampled hot path; Node.read stamps a monotonic float on
+        # 1-in-N reads so completion can observe readindex latency
+        # (proposals carry their trace on the Entry instead — the same
+        # object travels propose -> arena -> commit -> apply)
+        self.lat = None
 
     def notify(self, result: RequestResult) -> None:
         self._result = result
@@ -220,7 +226,19 @@ class RequestState:
         the completing engine thread, so cb must be brief and non-blocking
         (used by the embedding ABI's event delivery; cf. the reference's
         Event.Set discipline, binding dragonboat.h:377-394). Fires
-        immediately if already complete."""
+        immediately if already complete. Callbacks COMPOSE: a second
+        registration chains after the first instead of replacing it (the
+        latency sampler registers on 1-in-N reads before the caller gets
+        the RequestState — a replacing slot would silently drop whichever
+        callback came first)."""
+        prev = self._cb
+        if prev is not None:
+            nxt = cb
+
+            def cb(rs, _prev=prev, _nxt=nxt):
+                _prev(rs)
+                _nxt(rs)
+
         self._cb = cb
         if self._event.is_set():
             self._fire_cb()

@@ -44,6 +44,15 @@ class ManagedStateMachine:
     def concurrent_snapshot(self) -> bool:
         return False
 
+    def exclusive(self):
+        """The wrapper's serialization lock (reentrant). Non-concurrent
+        SMs hand it to the manager so `update + applied-index advance`
+        and `snapshot (index label + data write)` each form ONE critical
+        section — without it a save racing an apply can label data from
+        index i+k with index i, and restart replay double-applies
+        (i, i+k]."""
+        return self._mu
+
     def on_disk(self) -> bool:
         return False
 

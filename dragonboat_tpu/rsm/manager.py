@@ -9,6 +9,7 @@ replicated apply path, so every replica makes identical decisions.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -377,11 +378,20 @@ class StateMachineManager:
     def save_snapshot(self, req: Optional[SSRequest] = None) -> Tuple[Snapshot, object]:
         """Synchronously produce a snapshot (cf. statemachine.go:513-525,
         697-749). For concurrent SMs prepare runs under the apply mutex and
-        the streaming write runs outside it."""
+        the streaming write runs outside it. For NON-concurrent SMs the
+        index label and the data write are one critical section under the
+        wrapper mutex — a save racing the apply path could otherwise label
+        post-capture data with a pre-capture index, and restart replay
+        would re-apply the gap (observed as a double-applied counter)."""
         req = req or SSRequest()
-        meta = self._get_ss_meta(req)
-        ss, env = self._snapshotter.save(self._make_save_fn(meta), meta)
-        return ss, env
+        if self._sm.concurrent_snapshot() or self._sm.on_disk():
+            meta = self._get_ss_meta(req)
+            ss, env = self._snapshotter.save(self._make_save_fn(meta), meta)
+            return ss, env
+        with self._sm.exclusive():
+            meta = self._get_ss_meta(req)
+            ss, env = self._snapshotter.save(self._make_save_fn(meta), meta)
+            return ss, env
 
     def stream_snapshot(self, sink) -> None:
         """Stream live state to a lagging peer (on-disk SMs,
@@ -416,6 +426,17 @@ class StateMachineManager:
         self._sm.sync()
 
     # --------------------------------------------------------------- applying
+    def _apply_section(self):
+        """Critical section for `sm.update + applied-index advance`: a
+        non-concurrent SM returns the wrapper mutex (the same lock
+        save_snapshot holds across its index label + data write), so a
+        snapshot can never capture an index older than the data it saves.
+        Concurrent/on-disk SMs take point-in-time snapshots through
+        prepare_snapshot and need no cross-section — they get a no-op."""
+        if self._sm.concurrent_snapshot() or self._sm.on_disk():
+            return contextlib.nullcontext()
+        return self._sm.exclusive()
+
     def handle(self, batch: List[Task], apply: List[SMEntry]) -> Optional[Task]:
         """Drain the task queue, applying entry batches; returns the first
         snapshot task encountered (the engine routes it to a snapshot
@@ -491,7 +512,13 @@ class StateMachineManager:
         skip_until = self._on_disk_init_index if self._sm.on_disk() else 0
         smes = [SMEntry(index=e.index, cmd=decode_payload(e)) for e in ents]
         to_run = [se for se in smes if se.index > skip_until]
-        done = self._sm.update(to_run) if to_run else []
+        last = ents[-1]
+        with self._apply_section():
+            done = self._sm.update(to_run) if to_run else []
+            with self._mu:
+                self._set_applied(last.index, last.term)
+                if self._sm.on_disk():
+                    self._on_disk_index = max(self._on_disk_index, last.index)
         # per-proposal results are only retained for per-request keys;
         # batch-tracked proposals complete by count alone, so the common
         # bulk path skips the result realignment entirely
@@ -501,11 +528,6 @@ class StateMachineManager:
             results = [by_index.get(e.index, empty) for e in ents]
         else:
             results = None
-        last = ents[-1]
-        with self._mu:
-            self._set_applied(last.index, last.term)
-            if self._sm.on_disk():
-                self._on_disk_index = max(self._on_disk_index, last.index)
         run_notify = getattr(self._node, "apply_update_run", None)
         if run_notify is not None:
             run_notify(ents, results)
@@ -559,6 +581,9 @@ class StateMachineManager:
         self._node.apply_update(e, Result(), False, True, True)
 
     def _apply_batch(self, apply: List[SMEntry]) -> None:
+        # only reachable for concurrent/on-disk SMs (_handle_batch's
+        # use_batch gate), whose snapshots are point-in-time — no
+        # _apply_section needed here
         if not apply:
             return
         skip_until = self._on_disk_init_index if self._sm.on_disk() else 0
@@ -636,23 +661,24 @@ class StateMachineManager:
 
     def _do_update(self, e: Entry, notify_read: bool, session: int = 0) -> None:
         skip = self._sm.on_disk() and e.index <= self._on_disk_init_index
-        if skip:
-            results = [SMEntry(index=e.index, cmd=decode_payload(e))]
-        else:
-            results = self._sm.update(
-                [SMEntry(index=e.index, cmd=decode_payload(e))]
-            )
-        result = results[0].result if results else Result()
-        with self._mu:
-            if session:
-                s = self._sessions.get_registered_client(session)
-                if s is not None and not s.has_responded(e.series_id):
-                    got, has = s.get_response(e.series_id)
-                    if not has:
-                        s.add_response(e.series_id, result)
-            self._set_applied(e.index, e.term)
-            if self._sm.on_disk():
-                self._on_disk_index = max(self._on_disk_index, e.index)
+        with self._apply_section():
+            if skip:
+                results = [SMEntry(index=e.index, cmd=decode_payload(e))]
+            else:
+                results = self._sm.update(
+                    [SMEntry(index=e.index, cmd=decode_payload(e))]
+                )
+            result = results[0].result if results else Result()
+            with self._mu:
+                if session:
+                    s = self._sessions.get_registered_client(session)
+                    if s is not None and not s.has_responded(e.series_id):
+                        got, has = s.get_response(e.series_id)
+                        if not has:
+                            s.add_response(e.series_id, result)
+                self._set_applied(e.index, e.term)
+                if self._sm.on_disk():
+                    self._on_disk_index = max(self._on_disk_index, e.index)
         self._node.apply_update(e, result, False, False, notify_read)
 
     def _handle_config_change(self, e: Entry) -> bool:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -125,6 +126,12 @@ class IKVStore:
         return None
 
     def full_compaction(self) -> None:
+        return None
+
+    def set_fsync_observer(self, cb: Optional[Callable[[float], None]]) -> None:
+        """Install a durability-barrier latency observer: cb(seconds) runs
+        after each fsync with its wall duration. Stores without a real
+        barrier ignore it (this default)."""
         return None
 
 
@@ -265,6 +272,21 @@ class WalKV(IKVStore):
         self._replay()
         self._f = open(self._path, "ab")
         self._since_compact = 0
+        # fsync-latency observer (cb(seconds)); None = zero extra work
+        self._fsync_observer: Optional[Callable[[float], None]] = None
+
+    def set_fsync_observer(self, cb: Optional[Callable[[float], None]]) -> None:
+        self._fsync_observer = cb
+
+    def _barrier(self) -> None:
+        """The durability barrier, timed when an observer is installed."""
+        obs = self._fsync_observer
+        if obs is None:
+            os.fsync(self._f.fileno())
+            return
+        t0 = time.monotonic()
+        os.fsync(self._f.fileno())
+        obs(time.monotonic() - t0)
 
     def name(self) -> str:
         return "walkv"
@@ -313,7 +335,7 @@ class WalKV(IKVStore):
             self._append_rec(_OP_COMMIT, b"", b"")  # seal the group
             self._f.flush()
             if self._fsync:
-                os.fsync(self._f.fileno())
+                self._barrier()
             self._mem.commit_write_batch(wb)
             self._since_compact += len(wb.ops)
 
@@ -335,7 +357,7 @@ class WalKV(IKVStore):
             return
         with self._mu:
             if not self._f.closed:
-                os.fsync(self._f.fileno())
+                self._barrier()
 
     def bulk_remove_entries(self, fk, lk) -> None:
         wb = WriteBatch()

@@ -46,25 +46,36 @@ class _Shard:
         # (cid, nid) -> (batch_id, entries of that batch as last written)
         self._batch_cache = {}
         self._mu = threading.Lock()
+        # writer lock: the append path's boundary-batch read-modify-write
+        # (+ its kv commit) and remove_entries_to's boundary rewrite
+        # mutate the SAME tail batch record from different threads (step
+        # worker vs snapshot worker). Without mutual exclusion the
+        # compaction can read the record, lose the race to a tail append,
+        # and write the pre-append content back — silently DELETING the
+        # just-appended entries (observed as a log hole at restart:
+        # replay stalls at the hole with commit far ahead).
+        self._wmu = threading.Lock()
 
     # -- save path -----------------------------------------------------------
     def save_raft_state(self, updates: Sequence[Update]) -> None:
-        wb = WriteBatch()
-        for ud in updates:
-            self._record_update(wb, ud)
-        if wb.count() > 0:
-            self.kv.commit_write_batch(wb)
+        with self._wmu:
+            wb = WriteBatch()
+            for ud in updates:
+                self._record_update(wb, ud)
+            if wb.count() > 0:
+                self.kv.commit_write_batch(wb)
 
     def save_raft_state_deferred(self, updates: Sequence[Update]):
         """Write one batch for `updates` with the durability barrier
         deferred; returns the kv store owing a sync(), or None when
         nothing was written (or the store needs no separate barrier)."""
-        wb = WriteBatch()
-        for ud in updates:
-            self._record_update(wb, ud)
-        if wb.count() > 0 and self.kv.commit_write_batch_deferred(wb):
-            return self.kv
-        return None
+        with self._wmu:
+            wb = WriteBatch()
+            for ud in updates:
+                self._record_update(wb, ud)
+            if wb.count() > 0 and self.kv.commit_write_batch_deferred(wb):
+                return self.kv
+            return None
 
     def _save_entries(self, wb: WriteBatch, cid: int, nid: int, ents) -> None:
         """Pack entries into batch records, merging the head batch with any
@@ -190,21 +201,30 @@ class _Shard:
         self.kv.bulk_remove_entries(fk, lk)
         # the boundary batch straddles the cut: rewrite it with only the
         # surviving tail so removed indexes never resurface through a
-        # direct iterate (the ILogDB contract; cf. batch.go:312-340)
-        bk = keys.batch_key(cid, nid, cut_bid)
-        raw = self.kv.get_value(bk)
-        if raw:
-            batch, _ = codec.decode_entries(raw)
-            keep = [e for e in batch if e.index > index]
-            if len(keep) != len(batch):
-                if keep:
-                    self.kv.put_value(bk, codec.encode_entries(keep))
-                else:
-                    self.kv.delete_value(bk)
-                with self._mu:
-                    cached = self._batch_cache.get((cid, nid))
-                    if cached is not None and cached[0] == cut_bid:
-                        self._batch_cache[(cid, nid)] = (cut_bid, keep, None)
+        # direct iterate (the ILogDB contract; cf. batch.go:312-340).
+        # The rewrite runs under the shard writer lock: it is a
+        # read-modify-write of the record the append path may be extending
+        # right now — an unserialized rewrite can write the pre-append
+        # content back and DELETE freshly appended entries (the log-hole
+        # bug guarded by tests/test_storage.py::
+        # test_compaction_append_race_keeps_tail_entries)
+        with self._wmu:
+            bk = keys.batch_key(cid, nid, cut_bid)
+            raw = self.kv.get_value(bk)
+            if raw:
+                batch, _ = codec.decode_entries(raw)
+                keep = [e for e in batch if e.index > index]
+                if len(keep) != len(batch):
+                    if keep:
+                        self.kv.put_value(bk, codec.encode_entries(keep))
+                    else:
+                        self.kv.delete_value(bk)
+                    with self._mu:
+                        cached = self._batch_cache.get((cid, nid))
+                        if cached is not None and cached[0] == cut_bid:
+                            self._batch_cache[(cid, nid)] = (
+                                cut_bid, keep, None
+                            )
 
     def compact_entries_to(self, cid: int, nid: int, index: int) -> None:
         fk, lk = keys.batch_range(cid, nid, 0, (index + 1) // self.BATCH)
@@ -253,6 +273,15 @@ class ShardedLogDB(ILogDB):
 
     def name(self) -> str:
         return "sharded-" + self._shards[0].kv.name()
+
+    def set_fsync_observer(self, cb) -> None:
+        """Install a durability-barrier latency observer (cb(seconds)) on
+        every shard store — NodeHost feeds it into its
+        fsync_latency_seconds histogram."""
+        for s in self._shards:
+            set_obs = getattr(s.kv, "set_fsync_observer", None)
+            if set_obs is not None:
+                set_obs(cb)
 
     def close(self) -> None:
         for s in self._shards:

@@ -1,33 +1,69 @@
-"""Sampled latency profiler for the execution engine hot loop.
+"""Sampled latency profiler for the execution engine hot loop, request
+latency plumbing, and the flight recorder.
 
 cf. reference trace.go:29-162: bounded percentile samples (p50/p99/p999)
 per pipeline stage, recorded every `sample_ratio` iterations so the
 steady-state cost is one time.monotonic() pair per stage only on sampled
 iterations, nothing otherwise. Dumped via logger at engine stop
 (cf. execengine.go:197-211).
+
+This module also hosts the observability plane's two cheap primitives:
+
+  * LatencySampler / LatencyTrace — the sampled-request seam: 1-in-N
+    requests get a trace object stamped at propose/commit/apply; the rest
+    pay one integer increment and stay allocation-free.
+  * FlightRecorder — a bounded, lock-free (GIL-atomic deque) ring of
+    structured events with monotonic timestamps. Subsystems append
+    postmortem-grade breadcrumbs (leader changes, breaker transitions,
+    queue evictions, fault injections, fairness clamps); the pytest
+    failure hook dumps the ring as JSONL next to the CHAOS_SEED so chaos
+    replays come with a timeline.
 """
 from __future__ import annotations
 
+import json
+import random
 import time
+import zlib
+from collections import deque
 from typing import Dict, List, Optional
 
 
 class Sample:
-    """Bounded sample with cheap percentiles (cf. trace.go:29-96)."""
+    """Bounded reservoir sample with cheap percentiles (cf. trace.go:29-96).
 
-    __slots__ = ("name", "_vals", "_cap")
+    Reservoir semantics (Vitter's algorithm R, deterministic per-name
+    seed): every recorded value has equal probability of being in the
+    reservoir, so long-run percentiles reflect the WHOLE run. The old
+    fill-then-freeze cap silently dropped everything after the first 50k
+    values, skewing percentiles toward bring-up. mean() stays exact (sum
+    over all values); __len__ reports values SEEN, keeping the profiler's
+    total_s accounting unchanged."""
+
+    __slots__ = ("name", "_vals", "_cap", "_seen", "_sum", "_rng")
 
     def __init__(self, name: str, cap: int = 50_000) -> None:
         self.name = name
         self._vals: List[float] = []
         self._cap = cap
+        self._seen = 0
+        self._sum = 0.0
+        # deterministic seed: same name + same value stream => same
+        # reservoir, so profiler output is reproducible run to run
+        self._rng = random.Random(zlib.crc32(name.encode()) + cap)
 
     def record(self, v: float) -> None:
+        self._seen += 1
+        self._sum += v
         if len(self._vals) < self._cap:
             self._vals.append(v)
+        else:
+            j = self._rng.randrange(self._seen)
+            if j < self._cap:
+                self._vals[j] = v
 
     def __len__(self) -> int:
-        return len(self._vals)
+        return self._seen
 
     def percentile(self, p: float) -> float:
         if not self._vals:
@@ -37,11 +73,11 @@ class Sample:
         return s[k]
 
     def mean(self) -> float:
-        return sum(self._vals) / len(self._vals) if self._vals else 0.0
+        return self._sum / self._seen if self._seen else 0.0
 
     def report(self) -> str:
         return (
-            f"{self.name}: n={len(self._vals)} mean={self.mean()*1e6:.1f}us "
+            f"{self.name}: n={len(self)} mean={self.mean()*1e6:.1f}us "
             f"p50={self.percentile(0.50)*1e6:.1f}us "
             f"p99={self.percentile(0.99)*1e6:.1f}us "
             f"p999={self.percentile(0.999)*1e6:.1f}us"
@@ -113,4 +149,104 @@ class Profiler:
         return sorted(sm, key=lambda n: -sm[n]["total_s"])[:k]
 
 
-__all__ = ["Sample", "Profiler", "STAGES"]
+# ---------------------------------------------------------------------------
+# sampled request latency (the proposal-lifecycle histograms' cheap seam)
+# ---------------------------------------------------------------------------
+
+
+class LatencySampler:
+    """1-in-N request sampler. sample() costs one increment + one modulo;
+    only sampled requests allocate a LatencyTrace, so the unsampled hot
+    path stays allocation-free. Counter races under free threading lose or
+    add the odd sample — telemetry, not accounting."""
+
+    __slots__ = ("ratio", "_n")
+
+    def __init__(self, ratio: int) -> None:
+        self.ratio = max(1, int(ratio))
+        self._n = 0
+
+    def sample(self) -> bool:
+        self._n += 1
+        return self._n % self.ratio == 0
+
+
+class LatencyTrace:
+    """Per-sampled-request timestamps, carried on the RequestState AND the
+    proposed Entry (the same object travels propose -> arena -> commit ->
+    apply on the proposing node, so the engine can stamp t_commit without
+    a registry lookup). `owner` pins observation to the proposing node —
+    co-hosted replicas apply the identical Entry objects and must not
+    double-count; `done` makes observation exactly-once-ish."""
+
+    __slots__ = ("owner", "t0", "t_commit", "done")
+
+    def __init__(self, owner, t0: float) -> None:
+        self.owner = owner
+        self.t0 = t0
+        self.t_commit = 0.0
+        self.done = False
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of structured events with monotonic timestamps.
+
+    append (record) is one deque.append of a small tuple — GIL-atomic, no
+    lock — so producers on engine/transport/apply threads pay nanoseconds.
+    The ring bounds memory: a runaway event source overwrites the oldest
+    breadcrumbs instead of growing without limit."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, capacity: int = 8192) -> None:
+        self._buf: deque = deque(maxlen=capacity)
+
+    def record(self, event: str, **fields) -> None:
+        self._buf.append((time.monotonic(), event, fields or None))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def reset(self) -> None:
+        self._buf.clear()
+
+    def dump(self) -> List[dict]:
+        """Events oldest-first as plain dicts (t = monotonic seconds)."""
+        out = []
+        for t, event, fields in list(self._buf):
+            d = {"t": round(t, 6), "event": event}
+            if fields:
+                d.update(fields)
+            out.append(d)
+        return out
+
+    def to_jsonl(self) -> str:
+        return "\n".join(
+            json.dumps(d, default=str, sort_keys=True) for d in self.dump()
+        )
+
+
+# process-global recorder: every subsystem appends here so a test failure
+# dump needs no plumbing — one timeline covers all NodeHosts in the process
+# (events carry their own identity fields)
+_global_recorder = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    return _global_recorder
+
+
+__all__ = [
+    "Sample",
+    "Profiler",
+    "STAGES",
+    "LatencySampler",
+    "LatencyTrace",
+    "FlightRecorder",
+    "flight_recorder",
+]

@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional
 from ..core.rate import RateLimiter
 from ..raftio import IMessageHandler, IRaftRPC
 from ..settings import soft
+from ..trace import flight_recorder
 from ..types import Message, MessageBatch, MessageType
 from .nodes import Nodes
 
@@ -77,7 +78,9 @@ class _Breaker:
         jitter: float = 0.25,
         rng: Optional[random.Random] = None,
         clock: Callable[[], float] = time.monotonic,
+        name: str = "",
     ) -> None:
+        self._name = name  # target address, for flight-recorder events
         self._threshold = threshold
         self._base = base_cooldown
         self._max = max_cooldown
@@ -125,13 +128,17 @@ class _Breaker:
 
     def success(self) -> None:
         with self._mu:
+            reclosed = self._state == self.OPEN
             self._state = self.CLOSED
             self._fails = 0
             self._nominal = self._base
             self._probe_inflight = False
+        if reclosed:
+            flight_recorder().record("breaker_closed", addr=self._name)
 
     def fail(self) -> None:
         with self._mu:
+            tripped = False
             if self._state == self.CLOSED:
                 self._fails += 1
                 if self._fails < self._threshold:
@@ -139,6 +146,7 @@ class _Breaker:
                 self._state = self.OPEN
                 self.opens += 1
                 self._nominal = self._base
+                tripped = True
             else:
                 # a failed half-open probe (or a straggler failure while
                 # open): back off exponentially, re-arm the cooldown
@@ -148,6 +156,11 @@ class _Breaker:
             self._probe_inflight = False
             self._cooldown = self._jittered(self._nominal)
             self._opened_at = self._clock()
+            cooldown = self._cooldown
+        if tripped:
+            flight_recorder().record(
+                "breaker_open", addr=self._name, cooldown_s=round(cooldown, 4)
+            )
 
     # -- introspection -----------------------------------------------------
     def is_open(self) -> bool:
@@ -186,6 +199,7 @@ class _SendQueue:
         "_bulk",
         "_cv",
         "_closed",
+        "name",
         "rl",
         "thread",
         "evicted_bulk",
@@ -193,7 +207,8 @@ class _SendQueue:
         "dropped_urgent",
     )
 
-    def __init__(self, maxlen: int, max_bytes: int = 0) -> None:
+    def __init__(self, maxlen: int, max_bytes: int = 0, name: str = "") -> None:
+        self.name = name  # target address, for flight-recorder events
         self._maxlen = maxlen
         self._urgent: deque = deque()
         self._bulk: deque = deque()
@@ -215,8 +230,22 @@ class _SendQueue:
                 ev = self._bulk.popleft()
                 self.rl.decrease(_msg_size(ev))
                 self.evicted_bulk += 1
+                # sampled breadcrumb: first eviction + every 64th, so a
+                # sustained backpressure storm costs O(storm/64) events
+                if (self.evicted_bulk - 1) % 64 == 0:
+                    flight_recorder().record(  # hot-path: ok (1-in-64)
+                        "sendq_evicted_bulk", addr=self.name,
+                        total=self.evicted_bulk,
+                    )
             elif urgent:
                 self.dropped_urgent += 1
+                # always recorded: a dropped heartbeat/vote is the event a
+                # postmortem is looking for (it should ~never happen —
+                # the queue must fill with urgent traffic alone first)
+                flight_recorder().record(  # hot-path: ok (anomaly-only)
+                    "sendq_dropped_urgent", addr=self.name,
+                    total=self.dropped_urgent,
+                )
                 return False
             else:
                 self.dropped_bulk += 1
@@ -457,7 +486,7 @@ class Transport:
                 # deterministic per-address jitter stream so chaos runs
                 # replay with identical breaker timing
                 b = self._breakers[addr] = _Breaker(
-                    rng=random.Random(zlib.crc32(addr.encode()))
+                    rng=random.Random(zlib.crc32(addr.encode())), name=addr
                 )
             return b
 
@@ -465,7 +494,9 @@ class Transport:
         with self._mu:
             sq = self._queues.get(addr)
             if sq is None:
-                sq = self._queues[addr] = _SendQueue(self._qlen, self._qbytes)
+                sq = self._queues[addr] = _SendQueue(
+                    self._qlen, self._qbytes, name=addr
+                )
                 sq.thread = threading.Thread(
                     target=self._process_queue,
                     args=(addr, sq),

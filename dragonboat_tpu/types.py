@@ -146,6 +146,10 @@ class Entry:
     series_id: int = 0
     responded_to: int = 0
     cmd: bytes = b""
+    # sampled latency trace (trace.LatencyTrace), attached at propose time
+    # to 1-in-N proposals on the PROPOSING node only; never serialized (the
+    # codec copies explicit fields), None everywhere else
+    lat: Optional[object] = None
 
     def is_config_change(self) -> bool:
         return self.type == EntryType.CONFIG_CHANGE

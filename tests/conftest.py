@@ -37,12 +37,68 @@ def pytest_configure(config):
 # the lock cycle is visible in CI output instead of an opaque timeout.
 import faulthandler  # noqa: E402
 
+import pytest  # noqa: E402
+
 _HANG_DUMP_S = 600
 
 
 def pytest_runtest_setup(item):
     faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=False)
+    # fresh flight-recorder timeline per test: a failure dump must show
+    # THIS test's events, not the tail of whatever ran before it
+    try:
+        from dragonboat_tpu.trace import flight_recorder
+
+        flight_recorder().reset()
+    except Exception:
+        pass
 
 
 def pytest_runtest_teardown(item, nextitem):
     faulthandler.cancel_dump_traceback_later()
+
+
+# ---- flight recorder failure dump (the forensic half of the CHAOS_SEED
+# story): any test failure writes the process-global FlightRecorder ring
+# as JSONL next to the printed seed, so a chaos replay comes with the
+# timeline of what the cluster actually did — leader changes, breaker
+# trips, queue evictions, fault injections, fairness clamps. ----
+import json as _json  # noqa: E402
+import re as _re  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if not rep.failed:
+        return  # dump on ANY failing phase: setup failures (cluster never
+        # elected) and teardown assertions need the timeline most
+    try:
+        from dragonboat_tpu.trace import flight_recorder
+
+        rec = flight_recorder()
+        events = rec.dump()
+        if not events:
+            return
+        dump_dir = os.environ.get("FLIGHT_DUMP_DIR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", ".pytest_flight"
+        )
+        dump_dir = os.path.abspath(dump_dir)
+        os.makedirs(dump_dir, exist_ok=True)
+        safe = _re.sub(r"[^A-Za-z0-9_.-]+", "_", item.nodeid)[-120:]
+        suffix = "" if rep.when == "call" else f"-{rep.when}"
+        path = os.path.join(dump_dir, safe + suffix + ".jsonl")
+        with open(path, "w") as f:
+            f.write(rec.to_jsonl() + "\n")
+        tail = "\n".join(
+            _json.dumps(e, default=str, sort_keys=True) for e in events[-25:]
+        )
+        rep.sections.append(
+            (
+                "flight recorder",
+                f"{len(events)} events -> {path}\nlast events:\n{tail}",
+            )
+        )
+    except Exception:
+        pass  # the dump must never turn a failure into an error

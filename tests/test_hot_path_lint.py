@@ -22,6 +22,12 @@ seam exists so one queue lock + one breaker check covers a whole target
 batch (_SendQueue.put_many / Transport.send_many); a per-message lock
 acquisition silently reintroduces O(messages) synchronization per step.
 
+The observability plane adds a third rule (HOT_TELEMETRY_FUNCTIONS): no
+`Histogram.observe(...)` / flight-recorder `.record(...)` call in a hot
+function unless it sits under a sampling guard (an `if` whose condition
+mentions a sampler/latency gate) — per-message unconditional telemetry
+is exactly the O(messages) host work the columnar refactor removed.
+
 Slow paths (catchup, snapshot feedback, reconciles, rebase, `_maintain`)
 are intentionally NOT listed: they run on rare lanes and may use
 per-element access. A genuinely unavoidable exception inside a hot
@@ -60,6 +66,18 @@ HOT_FUNCTIONS = [
 HOT_LOCK_FUNCTIONS = [
     (transport, "Transport", "send_many"),
     (transport, "_SendQueue", "put_many"),
+]
+
+# functions where histogram observation / flight-recorder appends must be
+# sampling-guarded: the whole VectorEngine step loop plus the transport's
+# bulk send seams INCLUDING the per-message admission helper they call
+# (its intentional anomaly-only records carry the whitelist mark)
+HOT_TELEMETRY_FUNCTIONS = [
+    (vector, cls, fn) for cls, fn in HOT_FUNCTIONS
+] + [
+    (transport, "Transport", "send_many"),
+    (transport, "_SendQueue", "put_many"),
+    (transport, "_SendQueue", "_admit_locked"),
 ]
 
 WHITELIST_MARK = "hot-path: ok"
@@ -149,6 +167,49 @@ def _lock_violations_in(fn_node, src_lines, first_lineno, fn_label):
     return out
 
 
+_TELEMETRY_CALLS = ("observe", "record")
+# identifier fragments that mark a sampling/latency gate in an `if` test
+_GUARD_HINTS = ("sampl", "lat", "sstats")
+
+
+def _telemetry_violations_in(fn_node, src_lines, first_lineno, fn_label):
+    """Flag `.observe(...)` / `.record(...)` calls not nested under an
+    `if` whose condition references a sampling gate. Telemetry in a hot
+    function must be 1-in-N, never per-call."""
+    out = []
+
+    def guarded_by(test_node) -> bool:
+        dump = ast.dump(test_node).lower()
+        return any(h in dump for h in _GUARD_HINTS)
+
+    def visit(node, guarded):
+        if isinstance(node, ast.If):
+            g = guarded or guarded_by(node.test)
+            for c in node.body:
+                visit(c, g)
+            for c in node.orelse:
+                visit(c, guarded)
+            return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _TELEMETRY_CALLS
+            and not guarded
+        ):
+            line = src_lines[node.lineno - 1]
+            if WHITELIST_MARK not in line:
+                out.append(
+                    f"{fn_label}:{first_lineno + node.lineno - 1}: "
+                    f"unguarded .{node.func.attr}() telemetry in a hot "
+                    f"function: {line.strip()}"
+                )
+        for c in ast.iter_child_nodes(node):
+            visit(c, guarded)
+
+    visit(fn_node, False)
+    return out
+
+
 def test_hot_path_stays_columnar():
     problems = []
     for cls_name, fn_name in HOT_FUNCTIONS:
@@ -186,6 +247,73 @@ def test_transport_send_path_amortizes_locks():
             _lock_violations_in(fn_node, src_lines, first_lineno, label)
         )
     assert not problems, "\n".join(problems)
+
+
+def test_hot_path_telemetry_is_sampling_guarded():
+    problems = []
+    for module, cls_name, fn_name in HOT_TELEMETRY_FUNCTIONS:
+        label = f"{cls_name + '.' if cls_name else ''}{fn_name}"
+        try:
+            fn = _resolve(cls_name, fn_name, module)
+        except AttributeError:
+            problems.append(
+                f"{label}: hot function no longer exists — update the "
+                f"HOT_TELEMETRY_FUNCTIONS list"
+            )
+            continue
+        fn_node, (src_lines, first_lineno) = _function_ast(fn)
+        problems.extend(
+            _telemetry_violations_in(fn_node, src_lines, first_lineno, label)
+        )
+    assert not problems, "\n".join(problems)
+
+
+def test_telemetry_lint_catches_regressions():
+    bad_src = (
+        "def f(self, msgs):\n"
+        "    for m in msgs:\n"
+        "        self.metrics.observe('x', (0, 0), 1.0)\n"  # BANNED
+        "    recorder.record('evt', a=1)\n"  # BANNED (unguarded)
+        "    if self.profiler.sampling:\n"
+        "        self.metrics.observe('x', (0, 0), 1.0)\n"  # guarded: fine
+        "    if lat_sampler.sample():\n"
+        "        recorder.record('evt')\n"  # guarded: fine
+    )
+    tree = ast.parse(bad_src)
+    lines = bad_src.split("\n")
+    got = _telemetry_violations_in(tree.body[0], lines, 1, "f")
+    assert len(got) == 2, got
+
+
+def test_bench_json_carries_commit_latency_keys():
+    """BENCH JSON schema smoke test: the per-config latency report always
+    carries commit_latency_p50_s / commit_latency_p99_s (0.0 when no
+    samples landed), and real observations produce real percentiles."""
+    import bench
+    from dragonboat_tpu.events import MetricsRegistry
+
+    class FakeNH:
+        def __init__(self):
+            self.metrics = MetricsRegistry()
+
+    nh = FakeNH()
+    for v in (0.001, 0.002, 0.004, 0.008):
+        nh.metrics.observe("proposal_commit_latency_seconds", (1, 1), v)
+        nh.metrics.observe("proposal_apply_latency_seconds", (1, 1), 2 * v)
+    r = bench._latency_report({1: nh})
+    assert set(r) >= {
+        "commit_latency_p50_s",
+        "commit_latency_p99_s",
+        "commit_latency_samples",
+        "apply_latency_p99_s",
+        "fsync_latency_p99_s",
+    }
+    assert r["commit_latency_samples"] == 4
+    assert 0 < r["commit_latency_p50_s"] <= r["commit_latency_p99_s"]
+    # schema stability: keys exist even with zero hosts / zero samples
+    r0 = bench._latency_report({})
+    assert r0["commit_latency_p50_s"] == 0.0
+    assert r0["commit_latency_p99_s"] == 0.0
 
 
 def test_lock_lint_catches_regressions():

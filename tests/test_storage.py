@@ -400,3 +400,67 @@ def test_nodehost_on_sqlite_backend_restart(tmp_path):
         assert nh.stale_read(1, None) == 12
     finally:
         nh.stop()
+
+
+def test_compaction_append_race_keeps_tail_entries():
+    """remove_entries_to's boundary-batch rewrite vs a concurrent tail
+    append (snapshot worker vs step worker): the rewrite is a
+    read-modify-write of the batch record the append path is extending,
+    and an unserialized interleaving wrote the pre-append content back —
+    silently deleting just-appended entries (restart replay then stalls at
+    the hole with commit far ahead). The barrier KV below parks the
+    remover on its boundary read while an append commits; with the shard
+    writer lock the two serialize and no entry is lost in either order."""
+    import threading
+
+    from dragonboat_tpu.storage.logdb import _Shard
+
+    class RaceKV(MemKV):
+        def __init__(self):
+            super().__init__()
+            self.hold = threading.Event()
+            self.resume = threading.Event()
+            self.armed = False
+
+        def get_value(self, key):
+            v = super().get_value(key)
+            if self.armed and threading.current_thread().name == "remover":
+                self.armed = False
+                self.hold.set()
+                self.resume.wait(0.5)
+            return v
+
+    kv = RaceKV()
+    sh = _Shard(kv)
+    B = sh.BATCH
+
+    def save(lo, hi):
+        ents = [Entry(index=i, term=1, cmd=b"x") for i in range(lo, hi + 1)]
+        sh.save_raft_state(
+            [
+                Update(
+                    cluster_id=1,
+                    node_id=1,
+                    state=State(term=1, vote=1, commit=hi),
+                    entries_to_save=ents,
+                )
+            ]
+        )
+
+    # fill past two batch boundaries so the compaction cut lands inside a
+    # batch record that is ALSO the append tail
+    last = 2 * B + B // 2 + 1  # e.g. B=8 -> 21
+    save(1, last)
+    cut = 2 * B + 1  # boundary batch [2B .. 3B-1] straddles the cut
+    kv.armed = True
+    t = threading.Thread(
+        target=lambda: sh.remove_entries_to(1, 1, cut), name="remover"
+    )
+    t.start()
+    assert kv.hold.wait(5)
+    save(last + 1, last + 2)  # tail append into the same boundary batch
+    kv.resume.set()
+    t.join(5)
+    assert not t.is_alive()
+    ents, _ = sh.iterate_entries(1, 1, cut + 1, last + 3, 1 << 30)
+    assert [e.index for e in ents] == list(range(cut + 1, last + 3))
