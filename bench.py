@@ -470,7 +470,36 @@ def _bench_e2e_body(
     if host_stages:
         out.update(host_stages)
     out.update(_latency_report(hosts))
+    out.update(_lane_report(hosts))
     return out
+
+
+def _lane_report(hosts) -> dict:
+    """Per-lane introspection fold (VectorEngine.lane_stats: derived from
+    the numpy mirrors the decode phase maintains — zero device syncs).
+    Keys are ALWAYS present so the BENCH JSON schema stays stable: lane
+    count, leader coverage, and the worst/typical commit gap (how far any
+    lane's accepted log runs ahead of its quorum commit at bench end)."""
+    lanes_total = lanes_with_leader = 0
+    gap_max = 0
+    gaps = []
+    for nh in hosts.values():
+        lane_stats = getattr(getattr(nh, "engine", None), "lane_stats", None)
+        if lane_stats is None:
+            continue
+        for _cid, s in lane_stats().items():
+            lanes_total += 1
+            if s["leader_id"]:
+                lanes_with_leader += 1
+            gaps.append(s["commit_gap"])
+            gap_max = max(gap_max, s["commit_gap"])
+    gaps.sort()
+    return {
+        "lanes_total": lanes_total,
+        "lanes_with_leader": lanes_with_leader,
+        "lane_commit_gap_max": gap_max,
+        "lane_commit_gap_p50": gaps[len(gaps) // 2] if gaps else 0,
+    }
 
 
 def _latency_report(hosts) -> dict:

@@ -32,11 +32,12 @@ from .types import (
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
-# type, term, index, key, client_id, series_id, responded_to, cmd_len
-_ENTRY = struct.Struct("<BQQQQQQI")
+# type, term, index, key, client_id, series_id, responded_to, trace_id,
+# cmd_len
+_ENTRY = struct.Struct("<BQQQQQQQI")
 # type, to, from, cluster_id, term, log_term, log_index, commit, reject,
-# hint, hint_high, n_entries, has_snapshot
-_MSG = struct.Struct("<BQQQQQQQBQQIB")
+# hint, hint_high, trace_id, n_entries, has_snapshot
+_MSG = struct.Struct("<BQQQQQQQBQQQIB")
 _STATE = struct.Struct("<QQQ")
 
 
@@ -113,6 +114,7 @@ def encode_entry(e: Entry) -> bytes:
             e.client_id,
             e.series_id,
             e.responded_to,
+            e.trace_id,
             len(e.cmd),
         )
         + e.cmd
@@ -121,7 +123,9 @@ def encode_entry(e: Entry) -> bytes:
 
 @_checked
 def decode_entry(buf, off: int = 0) -> Tuple[Entry, int]:
-    t, term, index, key, cid, sid, resp, clen = _ENTRY.unpack_from(buf, off)
+    t, term, index, key, cid, sid, resp, tid, clen = _ENTRY.unpack_from(
+        buf, off
+    )
     off += _ENTRY.size
     _need(buf, off, clen)
     cmd = bytes(buf[off : off + clen])
@@ -134,6 +138,7 @@ def decode_entry(buf, off: int = 0) -> Tuple[Entry, int]:
             client_id=cid,
             series_id=sid,
             responded_to=resp,
+            trace_id=tid,
             cmd=cmd,
         ),
         off + clen,
@@ -326,6 +331,7 @@ def encode_message(m: Message) -> bytes:
             1 if m.reject else 0,
             m.hint,
             m.hint_high,
+            m.trace_id,
             len(m.entries),
             1 if m.snapshot is not None else 0,
         )
@@ -350,6 +356,7 @@ def decode_message(buf, off: int = 0) -> Tuple[Message, int]:
         reject,
         hint,
         hint_high,
+        tid,
         n_ent,
         has_ss,
     ) = _MSG.unpack_from(buf, off)
@@ -374,6 +381,7 @@ def decode_message(buf, off: int = 0) -> Tuple[Message, int]:
             reject=bool(reject),
             hint=hint,
             hint_high=hint_high,
+            trace_id=tid,
             entries=entries,
             snapshot=ss,
         ),

@@ -57,7 +57,7 @@ from ..types import (
     Snapshot,
     Update,
 )
-from ..trace import LatencyTrace
+from ..trace import LatencyTrace, flight_recorder, mint_trace_id
 from .quiesce import QuiesceManager
 from .queue import EntryQueue, MessageQueue, ReadIndexQueue
 from .snapshotstate import SnapshotState
@@ -190,6 +190,13 @@ class Node:
         if lt.owner is not self or lt.done:
             return
         lt.done = True
+        if lt.trace_id:
+            # final causal stage: the sampled proposal applied + notified
+            # on its proposing node
+            flight_recorder().record(
+                "proposal_applied", cluster=self.cluster_id,
+                node=self._node_id, trace=lt.trace_id,
+            )
         m = self._metrics_registry()
         if m is None:
             return
@@ -314,8 +321,17 @@ class Node:
         s = self._req_sampler
         if s is not None and s.sample():
             # propose-enqueue timestamp; the trace rides the Entry through
-            # arena -> commit -> apply and back to the histograms
-            entry.lat = LatencyTrace(self, time.monotonic())
+            # arena -> commit -> apply and back to the histograms. The
+            # trace id additionally rides the wire (Entry/Message codec)
+            # so remote hops stamp the same causal key.
+            entry.lat = LatencyTrace(
+                self, time.monotonic(), trace_id=mint_trace_id()
+            )
+            entry.trace_id = entry.lat.trace_id
+            flight_recorder().record(
+                "propose_enqueue", cluster=self.cluster_id,
+                node=self._node_id, trace=entry.trace_id,
+            )
         # optional payload compression at the propose boundary: the wire,
         # logdb and apply queue all carry the compressed form; replicas
         # decompress once at apply time (cf. rsm/encoded.go:47-176)
@@ -352,7 +368,15 @@ class Node:
         if entries and s is not None and s.sample():
             # one sampled entry per batch keeps the sampler's 1-in-N
             # meaning "1-in-N submissions", not "N samples per wave"
-            entries[-1].lat = LatencyTrace(self, time.monotonic())
+            e = entries[-1]
+            e.lat = LatencyTrace(
+                self, time.monotonic(), trace_id=mint_trace_id()
+            )
+            e.trace_id = e.lat.trace_id
+            flight_recorder().record(
+                "propose_enqueue", cluster=self.cluster_id,
+                node=self._node_id, trace=e.trace_id, batch=len(entries),
+            )
         for entry in entries:
             maybe_encode_entry(self.config.entry_compression_type, entry)
         accepted = self.incoming_proposals.add_many(entries)
@@ -405,7 +429,15 @@ class Node:
         ]
         s = self._req_sampler
         if entries and s is not None and s.sample():
-            entries[-1].lat = LatencyTrace(self, time.monotonic())
+            e = entries[-1]
+            e.lat = LatencyTrace(
+                self, time.monotonic(), trace_id=mint_trace_id()
+            )
+            e.trace_id = e.lat.trace_id
+            flight_recorder().record(
+                "propose_enqueue", cluster=self.cluster_id,
+                node=self._node_id, trace=e.trace_id, batch=len(entries),
+            )
         if self.config.entry_compression_type:
             for entry in entries:
                 maybe_encode_entry(self.config.entry_compression_type, entry)
@@ -637,6 +669,12 @@ class Node:
                 if not now:
                     now = time.monotonic()
                 lt.t_commit = now  # quorum commit observed (sampled entry)
+                if lt.trace_id:
+                    flight_recorder().record(
+                        "quorum_commit", cluster=self.cluster_id,
+                        node=self._node_id, trace=lt.trace_id,
+                        index=e.index,
+                    )
         self.sm.task_queue.add(
             Task(
                 cluster_id=self.cluster_id,

@@ -103,7 +103,7 @@ from ..ops.state import (
 from ..requests import LogicalClock
 from ..settings import soft
 from ..storage.kv import sync_all as _kv_sync_all
-from ..trace import LatencySampler, Profiler
+from ..trace import LatencySampler, Profiler, flight_recorder
 from ..types import (
     Entry,
     EntryType,
@@ -611,6 +611,19 @@ def gather_replicate_sends(
                     lane.node.describe(), b + prev + 1, b + prev + n,
                 )
                 continue
+        # causal trace: a sampled entry's trace id rides the Message (and
+        # the Entry codec) so the follower stamps the same key. Scanning
+        # is bounded by max_entries_per_msg; only the 1-in-N sampled case
+        # records anything.
+        trace_id = 0
+        for e in ents:
+            if e.trace_id:
+                trace_id = e.trace_id
+        if trace_id:
+            flight_recorder().record(
+                "replicate_send", cluster=lane.node.cluster_id,
+                node=lane.node.node_id(), to=to_nid, trace=trace_id,
+            )
         sends.append(
             (
                 lane,
@@ -623,6 +636,7 @@ def gather_replicate_sends(
                     log_index=b + prev,
                     log_term=prev_term,
                     commit=b + commit,
+                    trace_id=trace_id,
                     entries=ents,
                 ),
             )
@@ -748,9 +762,28 @@ def gather_resp_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
         wire = _RESP_WIRE.get(t)
         if wire is None:
             continue
+        trace_id = 0
         if wire == MT.REPLICATE_RESP:
             log_index += b
             hint += b
+            # ack hop of the causal chain: if the ACCEPTED index is a
+            # sampled entry this follower placed, carry its trace id back
+            # (one arena ring probe; records only on the 1-in-N case).
+            # Best-effort by design: a sampled entry that is not the last
+            # of its acked run goes unprobed, and rejected acks never
+            # probe — a reject's hint index can land on a stale
+            # conflicting arena entry and would misattribute an unrelated
+            # proposal's chain.
+            if not reject:
+                te = lane.arena.get(log_index)
+                if te is not None:
+                    trace_id = te.trace_id
+            if trace_id:
+                flight_recorder().record(
+                    "replicate_ack", cluster=lane.node.cluster_id,
+                    node=lane.node.node_id(), to=to_nid, trace=trace_id,
+                    index=log_index,
+                )
         sends.append(
             (
                 lane,
@@ -764,6 +797,7 @@ def gather_resp_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
                     reject=bool(reject),
                     hint=hint,
                     hint_high=hint2,
+                    trace_id=trace_id,
                 ),
             )
         )
@@ -1071,6 +1105,11 @@ class VectorEngine:
         self._m_snap_pending = np.zeros(G, bool)
         self._m_quiesced = np.zeros(G, bool)
         self._m_host = np.zeros(G, np.int32)  # owning handle id per lane
+        # engine-clock tick of the lane's last LEADER transition: feeds the
+        # per-lane ticks_since_leader_change gauge (lane_stats) with zero
+        # device syncs — updated only for lanes the decode phase already
+        # iterates as changed
+        self._m_leader_change_tick = np.zeros(G, np.int64)
 
     # ------------------------------------------------------- mirror helpers
     def _committed_real(self, g: int) -> int:
@@ -1756,6 +1795,19 @@ class VectorEngine:
                 lane.msg_backlog.appendleft(rest)
                 m.entries = head
                 n = E
+            # causal trace: the receive hop of a sampled entry's chain
+            # (after the split so a trace in the requeued tail records
+            # when ITS chunk packs)
+            trace_id = 0
+            for e in m.entries:
+                if e.trace_id:
+                    trace_id = e.trace_id
+            if trace_id:
+                flight_recorder().record(
+                    "replicate_recv", cluster=lane.node.cluster_id,
+                    node=lane.node.node_id(), from_node=m.from_,
+                    trace=trace_id,
+                )
             self._stage_row(
                 g, k, MSG.REPLICATE, from_slot=from_slot, term=m.term,
                 log_index=m.log_index - b, log_term=m.log_term,
@@ -1987,6 +2039,10 @@ class VectorEngine:
             ((new_leader != self._m_leader) | (new_term != self._m_term))
             & self._m_active
         )[0]
+        # old leader column for the changed lanes, captured before the
+        # rebind: distinguishes true LEADER transitions (which arm the
+        # ticks_since_leader_change gauge) from term-only churn
+        old_leader_changed = self._m_leader[changed]
         # device_get arrays can be read-only views: mirrors are mutated by
         # the activation/reconcile paths, so copy on rebind
         self._m_leader = np.array(new_leader)
@@ -1997,15 +2053,20 @@ class VectorEngine:
         self._m_last = o["last_index"].astype(np.int64)
         if changed.size:
             lead_n = elect_n = 0
-            for g, lslot, term in zip(
+            chg_tick = self.clock.tick
+            for g, lslot, old_lslot, term in zip(
                 changed.tolist(),
                 new_leader[changed].tolist(),
+                old_leader_changed.tolist(),
                 new_term[changed].tolist(),
             ):
                 lane = lane_by_g[g]
                 if lane is None or not lane.active:
                     continue
                 lead_n += 1
+                if lslot != old_lslot:
+                    # real leader transition (not term-only churn)
+                    self._m_leader_change_tick[g] = chg_tick
                 if lslot == 0:
                     # lane went leaderless: an election is underway
                     elect_n += 1
@@ -2098,6 +2159,13 @@ class VectorEngine:
                     if lt is not None and lt.t_commit == 0.0:
                         # sampled proposal reached quorum commit this step
                         lt.t_commit = t_commit
+                        if lt.trace_id:
+                            flight_recorder().record(
+                                "quorum_commit",
+                                cluster=lane.node.cluster_id,
+                                node=lane.node.node_id(),
+                                trace=lt.trace_id, index=e.index,
+                            )
                 if has_cc:
                     lane.cc_inflight = False
                 self.set_task_ready(lane.key)
@@ -2756,6 +2824,7 @@ class VectorEngine:
         self._m_applied_since[g] = 0
         self._m_snap_pending[g] = False
         self._m_quiesced[g] = False  # a reused lane must not inherit this
+        self._m_leader_change_tick[g] = self.clock.tick
         return dict(
             self_slot=max(self_slot, 0),
             member=member,
@@ -3156,6 +3225,37 @@ class VectorEngine:
         StepOutput, so reading them costs nothing on the device."""
         return dict(self._sstats)
 
+    def lane_stats(self) -> Dict[tuple, dict]:
+        """Per-lane introspection derived ENTIRELY from the numpy mirrors
+        the decode phase already maintains — zero device syncs: lane key ->
+        {node_id, leader_id, term, commit_gap, ticks_since_leader_change}.
+        commit_gap is last_index - commit_index in device units (how far
+        the lane's accepted log runs ahead of its quorum commit — a
+        persistently large gap flags a lane that cannot reach quorum).
+        Exported ~1/s by NodeHost._export_health_gauges as cluster_id-
+        labelled engine_lane_* gauges and folded into bench.py's JSON."""
+        out: Dict[tuple, dict] = {}
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+        leader = self._m_leader
+        term = self._m_term
+        commit = self._m_commit
+        last = self._m_last
+        chg = self._m_leader_change_tick
+        tick = self.clock.tick
+        for lane in lanes:
+            if not lane.active:
+                continue
+            g = lane.g
+            out[lane.key] = {
+                "node_id": lane.node.node_id(),
+                "leader_id": lane.rev.get(int(leader[g]) - 1, 0),
+                "term": int(term[g]),
+                "commit_gap": max(int(last[g] - commit[g]), 0),
+                "ticks_since_leader_change": max(int(tick - chg[g]), 0),
+            }
+        return out
+
     def leader_snapshot(self) -> Dict[tuple, Tuple[int, int]]:
         """One vectorized pass over the numpy mirrors: lane key ->
         (leader_node_id, term) for every active lane. Replaces per-group
@@ -3277,6 +3377,14 @@ class VectorEngineHandle:
         return {
             key[1]: v
             for key, v in self.core.leader_snapshot().items()
+            if key[0] == self.host
+        }
+
+    def lane_stats(self) -> Dict[int, dict]:
+        """cluster_id -> per-lane introspection for this host's lanes."""
+        return {
+            key[1]: v
+            for key, v in self.core.lane_stats().items()
             if key[0] == self.host
         }
 

@@ -36,7 +36,7 @@ from .requests import (
 from .rsm import SSRequest, SS_REQ_EXPORTED, SS_REQ_USER
 from .statemachine import Result, sm_type_of
 from .storage import LogReader, ShardedLogDB
-from .trace import flight_recorder
+from .trace import flight_recorder, read_mmap_ring
 from .transport import Transport, loopback_factory
 from .transport.tcp import tcp_factory
 from .types import (
@@ -201,6 +201,16 @@ class NodeHost(IMessageHandler):
         # ping/pong RTT samples: (cluster_id, peer) -> deque of microseconds
         self._rtt_mu = threading.Lock()
         self._rtt: Dict[tuple, object] = {}
+        # crash-persistent flight recorder: DRAGONBOAT_FLIGHT_RING=<path>
+        # tees the process-global recorder into an mmap ring so a
+        # SIGKILL'd host still leaves a timeline recover_flight_ring()
+        # can read (attach is idempotent across co-hosted NodeHosts)
+        ring_path = os.environ.get("DRAGONBOAT_FLIGHT_RING")
+        if ring_path:
+            try:
+                flight_recorder().attach_mmap(ring_path)
+            except Exception:
+                pass  # forensics must never block bring-up
 
     def _acquire_dir_lock(self) -> None:
         """Exclusive advisory lock on the nodehost dir (cf. reference
@@ -269,6 +279,28 @@ class NodeHost(IMessageHandler):
             full = f"dragonboat_tpu_transport_{name}_total"
             w.write(f"# TYPE {full} counter\n")
             w.write(f"{full} {v:g}\n")
+
+    # ----------------------------------------------------------- forensics
+    def dump_flight(self, path: str, cluster_id: Optional[int] = None) -> str:
+        """Write the process flight recorder as JSONL (optionally filtered
+        to one cluster) with a `_meta` header line so tools.timeline can
+        merge this host's dump with other hosts' on one clock. Returns
+        the path."""
+        rec = flight_recorder()
+        kw = {} if cluster_id is None else {"cluster_id": cluster_id}
+        with open(path, "w") as f:
+            f.write(
+                rec.to_jsonl(meta={"source": self.config.raft_address}, **kw)
+                + "\n"
+            )
+        return path
+
+    @staticmethod
+    def recover_flight_ring(path: str) -> List[dict]:
+        """Read a (possibly SIGKILL'd) process's mmap flight ring back as
+        an ordered event list (see trace.read_mmap_ring)."""
+        _meta, events = read_mmap_ring(path)
+        return events
 
     # ------------------------------------------------------------ start paths
     def start_cluster(
@@ -1038,6 +1070,26 @@ class NodeHost(IMessageHandler):
         if step_stats is not None:
             for name, v in step_stats().items():
                 self.metrics.set_gauge(f"engine_step_{name}", (0, 0), float(v))
+        # per-lane (cluster_id-labelled) introspection from the engine's
+        # numpy mirrors: leader, term, commit gap, ticks since the last
+        # leader change — zero device syncs (see VectorEngine.lane_stats)
+        lane_stats = getattr(self.engine, "lane_stats", None)
+        if lane_stats is not None:
+            for cid, s in lane_stats().items():
+                key = (cid, s["node_id"])
+                self.metrics.set_gauge(
+                    "engine_lane_leader_id", key, float(s["leader_id"])
+                )
+                self.metrics.set_gauge(
+                    "engine_lane_term", key, float(s["term"])
+                )
+                self.metrics.set_gauge(
+                    "engine_lane_commit_gap", key, float(s["commit_gap"])
+                )
+                self.metrics.set_gauge(
+                    "engine_lane_ticks_since_leader_change", key,
+                    float(s["ticks_since_leader_change"]),
+                )
 
 
 __all__ = [

@@ -1,0 +1,218 @@
+"""Forensic timeline CLI over flight-recorder artifacts.
+
+Merges flight-recorder dumps from N nodes — JSONL failure dumps
+(`.pytest_flight/*.jsonl`, NodeHost.dump_flight) and crash-persistent
+mmap rings (trace.MmapRing files left behind by SIGKILL'd processes) —
+into ONE ordered timeline, filters it by cluster / trace id / event type,
+and pretty-prints causal chains.
+
+Clock merging: each process's `time.monotonic()` has an arbitrary base,
+so raw `t` values from different dumps are not comparable. Every dump
+carries its process's wall-minus-monotonic offset (`mono_offset`: a
+`_meta` JSONL header line, or the mmap ring header), negotiated once at
+recorder creation; the merge normalizes every event to the wall clock
+(`t + mono_offset`) and sorts. Dumps without a meta line merge on raw
+`t` — correct for dumps split out of one process, best-effort otherwise.
+
+Usage:
+
+    python -m dragonboat_tpu.tools.timeline n1.jsonl n2.jsonl n3.ring \\
+        [--cluster 2] [--trace 0x1c0ffee00000001] [--event leader_changed]
+        [--chains] [--json]
+
+`--chains` groups the filtered events by trace id and prints each
+proposal's causal chain (propose_enqueue -> replicate_send ->
+replicate_recv -> quorum_commit -> proposal_applied) with per-stage
+deltas — the view that turns a chaos seed's `CHAOS_SEED` + `.pytest_flight/`
+artifacts into "what did this proposal actually do, on which node, when".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from ..trace import _RING_MAGIC, read_mmap_ring
+
+# stages in causal order, for chain rendering (unknown events sort by time)
+CHAIN_STAGES = (
+    "propose_enqueue",
+    "replicate_send",
+    "replicate_recv",
+    "replicate_ack",
+    "quorum_commit",
+    "proposal_applied",
+)
+
+
+def _is_ring(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(_RING_MAGIC)) == _RING_MAGIC
+    except OSError:
+        return False
+
+
+def load_dump(path: str) -> List[dict]:
+    """Load one artifact (JSONL dump or mmap ring) into normalized events:
+    each event gains `_src` (which dump it came from) and `_tw` (wall-clock
+    time, the cross-process merge axis)."""
+    if _is_ring(path):
+        meta, events = read_mmap_ring(path)
+    else:
+        meta = {"mono_offset": 0.0, "source": os.path.basename(path)}
+        events = []
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    continue  # tolerate a torn tail line
+                if d.get("event") == "_meta":
+                    meta.update(d)
+                else:
+                    events.append(d)
+    src = str(meta.get("source") or os.path.basename(path))
+    off = float(meta.get("mono_offset") or 0.0)
+    for e in events:
+        e["_src"] = src
+        e["_tw"] = float(e.get("t", 0.0)) + off
+    return events
+
+
+def merge_dumps(paths) -> List[dict]:
+    """One ordered timeline across every artifact (see module docstring
+    for the clock negotiation)."""
+    events: List[dict] = []
+    for p in paths:
+        events.extend(load_dump(p))
+    events.sort(key=lambda e: (e["_tw"], e.get("t", 0.0)))
+    return events
+
+
+def filter_events(
+    events: List[dict],
+    cluster: Optional[int] = None,
+    trace: Optional[int] = None,
+    kinds=None,
+) -> List[dict]:
+    out = []
+    for e in events:
+        if cluster is not None and e.get("cluster") != cluster:
+            continue
+        if trace is not None and e.get("trace") != trace:
+            continue
+        if kinds and e.get("event") not in kinds:
+            continue
+        out.append(e)
+    return out
+
+
+def causal_chains(events: List[dict]) -> Dict[int, List[dict]]:
+    """Group trace-stamped events by trace id, each chain time-ordered."""
+    chains: Dict[int, List[dict]] = {}
+    for e in events:
+        tid = e.get("trace")
+        if not tid:
+            continue
+        chains.setdefault(tid, []).append(e)
+    for evs in chains.values():
+        evs.sort(key=lambda e: e["_tw"])
+    return chains
+
+
+def _fmt_fields(e: dict) -> str:
+    skip = {"t", "_tw", "_src", "event", "trace"}
+    parts = []
+    for k in sorted(e):
+        if k in skip:
+            continue
+        parts.append(f"{k}={e[k]}")
+    return " ".join(parts)
+
+
+def format_timeline(events: List[dict], out=None) -> None:
+    out = out or sys.stdout
+    if not events:
+        out.write("(no events)\n")
+        return
+    t0 = events[0]["_tw"]
+    for e in events:
+        tid = e.get("trace")
+        tag = f" trace={tid:#x}" if tid else ""
+        out.write(
+            f"+{e['_tw'] - t0:11.6f}s [{e['_src']}] "
+            f"{e['event']}{tag} {_fmt_fields(e)}\n"
+        )
+
+
+def format_chains(events: List[dict], out=None) -> int:
+    """Pretty-print every causal chain in the events; returns the number
+    of chains rendered."""
+    out = out or sys.stdout
+    chains = causal_chains(events)
+    for tid in sorted(chains):
+        evs = chains[tid]
+        nodes = sorted(
+            {e.get("node") for e in evs if e.get("node") is not None}
+        )
+        out.write(
+            f"trace {tid:#x}: {len(evs)} events, "
+            f"nodes {nodes}, cluster {evs[0].get('cluster')}\n"
+        )
+        t0 = evs[0]["_tw"]
+        for e in evs:
+            out.write(
+                f"  +{e['_tw'] - t0:9.6f}s {e['event']:<18} "
+                f"[{e['_src']}] {_fmt_fields(e)}\n"
+            )
+    if not chains:
+        out.write("(no trace-stamped events)\n")
+    return len(chains)
+
+
+def _parse_int(v: str) -> int:
+    return int(v, 0)  # accepts decimal and 0x...
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dragonboat_tpu.tools.timeline",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL dumps and/or mmap rings")
+    ap.add_argument("--cluster", type=_parse_int, default=None,
+                    help="only events of this raft group (0 = host-level)")
+    ap.add_argument("--trace", type=_parse_int, default=None,
+                    help="only events stamped with this trace id")
+    ap.add_argument("--event", action="append", default=None,
+                    help="only these event types (repeatable)")
+    ap.add_argument("--chains", action="store_true",
+                    help="group by trace id and print causal chains")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged, filtered events as JSONL")
+    args = ap.parse_args(argv)
+    events = filter_events(
+        merge_dumps(args.paths),
+        cluster=args.cluster,
+        trace=args.trace,
+        kinds=set(args.event) if args.event else None,
+    )
+    if args.json:
+        for e in events:
+            sys.stdout.write(json.dumps(e, default=str, sort_keys=True) + "\n")
+        return 0
+    if args.chains:
+        format_chains(events)
+        return 0
+    format_timeline(events)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,12 +21,17 @@ This module also hosts the observability plane's two cheap primitives:
 """
 from __future__ import annotations
 
+import itertools
 import json
+import mmap
+import os
 import random
+import struct
+import threading
 import time
 import zlib
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class Sample:
@@ -154,6 +159,19 @@ class Profiler:
 # ---------------------------------------------------------------------------
 
 
+# trace-id minting: a compact u64 that rides the sampled LatencyTrace path
+# (1-in-N proposals; the other N-1 never mint, never record). The high 32
+# bits are a per-process random salt so merged dumps from N nodes never
+# collide; the low 32 bits are a process-local counter. itertools.count is
+# a C-level iterator, so minting is one next() + two shifts.
+_TRACE_SALT = int.from_bytes(os.urandom(4), "little") or 1
+_trace_counter = itertools.count(1)
+
+
+def mint_trace_id() -> int:
+    return (_TRACE_SALT << 32) | (next(_trace_counter) & 0xFFFFFFFF)
+
+
 class LatencySampler:
     """1-in-N request sampler. sample() costs one increment + one modulo;
     only sampled requests allocate a LatencyTrace, so the unsampled hot
@@ -177,20 +195,166 @@ class LatencyTrace:
     apply on the proposing node, so the engine can stamp t_commit without
     a registry lookup). `owner` pins observation to the proposing node —
     co-hosted replicas apply the identical Entry objects and must not
-    double-count; `done` makes observation exactly-once-ish."""
+    double-count; `done` makes observation exactly-once-ish.
 
-    __slots__ = ("owner", "t0", "t_commit", "done")
+    `trace_id` is the cross-node causal key: minted at propose time
+    (mint_trace_id), copied onto the proposed Entry (and from there onto
+    wire Messages), and stamped into every flight-recorder event the
+    request touches — so merged multi-node dumps reconstruct one
+    proposal's propose -> replicate -> quorum -> apply chain."""
 
-    def __init__(self, owner, t0: float) -> None:
+    __slots__ = ("owner", "t0", "t_commit", "done", "trace_id")
+
+    def __init__(self, owner, t0: float, trace_id: int = 0) -> None:
         self.owner = owner
         self.t0 = t0
         self.t_commit = 0.0
         self.done = False
+        self.trace_id = trace_id
 
 
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
+
+
+# mmap ring layout: a 64-byte header followed by `capacity` fixed-size
+# slots. Each slot is [u64 seq | u32 len | payload-json]. The writer
+# invalidates (seq=0), writes the payload, then seals (seq=n) LAST — a
+# SIGKILL mid-write leaves exactly one unsealed slot and every other slot
+# readable, and recovery orders sealed slots by seq. mmap stores survive
+# process death (the pages live in the kernel's page cache), which is the
+# whole point: `timeout -k`/pytest-timeout kills leave a readable timeline
+# where the in-memory deque dies with the process.
+_RING_MAGIC = b"DBTPUFR1"
+_RING_HDR = struct.Struct("<8sIId")  # magic, capacity, slot_size, mono_off
+_RING_HDR_SIZE = 64
+_SLOT_HDR = struct.Struct("<QI")  # seq, payload length
+
+
+def _truncated_payload(payload: bytes, limit: int) -> bytes:
+    """Shrink an oversized event to a valid-JSON truncation marker that
+    keeps the load-bearing identity fields (when, what, which group),
+    shedding progressively if the slot is tiny."""
+    try:
+        d = json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        return b'{"_truncated": true}'
+    for keys, clip in (
+        (("t", "event", "cluster", "node", "trace", "nodeid"), 160),
+        (("t", "event", "cluster"), 80),
+        (("event",), 40),
+    ):
+        keep = {
+            k: (v[:clip] if isinstance(v, str) else v)
+            for k, v in d.items()
+            if k in keys
+        }
+        keep["_truncated"] = True
+        out = json.dumps(keep, default=str, sort_keys=True).encode()
+        if len(out) <= limit:
+            return out
+    return b'{"_truncated": true}'
+
+
+class MmapRing:
+    """Crash-persistent fixed-slot event ring (see layout note above).
+
+    write() is: lock, invalidate slot, copy payload, seal — a few hundred
+    nanoseconds on a warm page. Events are breadcrumb-rate (sampled or
+    anomaly-only; the hot-path lint enforces it), so the eager
+    json.dumps per event is fine here where it would not be on the step
+    path."""
+
+    def __init__(
+        self, path: str, capacity: int = 4096, slot_size: int = 512
+    ) -> None:
+        self.path = path
+        self.capacity = capacity
+        self.slot_size = slot_size
+        self.mono_offset = time.time() - time.monotonic()
+        self._mu = threading.Lock()
+        self._seq = 0
+        size = _RING_HDR_SIZE + capacity * slot_size
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        os.ftruncate(self._fd, size)
+        self._mm = mmap.mmap(self._fd, size)
+        hdr = _RING_HDR.pack(
+            _RING_MAGIC, capacity, slot_size, self.mono_offset
+        )
+        self._mm[: len(hdr)] = hdr
+        # zero the slot seals so a reused file never resurrects old events
+        for i in range(capacity):
+            off = _RING_HDR_SIZE + i * slot_size
+            self._mm[off : off + 8] = b"\x00" * 8
+
+    def write(self, payload: bytes) -> None:
+        limit = self.slot_size - _SLOT_HDR.size
+        if len(payload) > limit:
+            # a raw byte cut would leave invalid JSON that recovery drops
+            # as torn; degrade to a JSON-safe truncation marker instead so
+            # the event (when + what kind) survives in the crash timeline
+            payload = _truncated_payload(payload, limit)
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            off = _RING_HDR_SIZE + ((seq - 1) % self.capacity) * self.slot_size
+            mm = self._mm
+            mm[off : off + 8] = b"\x00" * 8  # invalidate
+            mm[off + 8 : off + 12] = struct.pack("<I", len(payload))
+            mm[off + 12 : off + 12 + len(payload)] = payload
+            mm[off : off + 8] = struct.pack("<Q", seq)  # seal
+
+    def flush(self) -> None:
+        try:
+            self._mm.flush()
+        except (ValueError, OSError):
+            pass
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._mm.flush()
+                self._mm.close()
+            except (ValueError, OSError):
+                pass
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+
+
+def read_mmap_ring(path: str) -> Tuple[dict, List[dict]]:
+    """Recover a (possibly SIGKILL'd) process's mmap ring: returns
+    (meta, events) with events ordered by their seal sequence. Unsealed or
+    torn slots (the one a kill interrupted, or an oversized truncated
+    payload) are skipped — the rest of the timeline stays valid."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < _RING_HDR_SIZE:
+        raise ValueError(f"{path}: not a flight ring (too small)")
+    magic, capacity, slot_size, mono_offset = _RING_HDR.unpack_from(raw, 0)
+    if magic != _RING_MAGIC:
+        raise ValueError(f"{path}: not a flight ring (bad magic)")
+    slots = []
+    for i in range(capacity):
+        off = _RING_HDR_SIZE + i * slot_size
+        if off + _SLOT_HDR.size > len(raw):
+            break
+        seq, n = _SLOT_HDR.unpack_from(raw, off)
+        if seq == 0 or n > slot_size - _SLOT_HDR.size:
+            continue
+        try:
+            d = json.loads(raw[off + 12 : off + 12 + n])
+        except (ValueError, UnicodeDecodeError):
+            continue  # torn slot: the write this kill interrupted
+        slots.append((seq, d))
+    slots.sort(key=lambda s: s[0])
+    meta = {"mono_offset": mono_offset, "source": os.path.basename(path)}
+    return meta, [d for _, d in slots]
 
 
 class FlightRecorder:
@@ -199,15 +363,36 @@ class FlightRecorder:
     append (record) is one deque.append of a small tuple — GIL-atomic, no
     lock — so producers on engine/transport/apply threads pay nanoseconds.
     The ring bounds memory: a runaway event source overwrites the oldest
-    breadcrumbs instead of growing without limit."""
+    breadcrumbs instead of growing without limit.
 
-    __slots__ = ("_buf",)
+    Every event carries a `cluster` field (0 = host-level: breakers,
+    send queues, fairness) so dumps filter server-side by Raft group.
+    attach_mmap() tees every record into a crash-persistent MmapRing so a
+    SIGKILL'd process still leaves a readable timeline (read_mmap_ring)."""
+
+    __slots__ = ("_buf", "_ring", "mono_offset")
 
     def __init__(self, capacity: int = 8192) -> None:
         self._buf: deque = deque(maxlen=capacity)
+        self._ring: Optional[MmapRing] = None
+        # wall-minus-monotonic at init: dumps carry it so the timeline CLI
+        # can merge rings/dumps from different processes (each process's
+        # monotonic clock has an arbitrary base) onto one wall-clock axis
+        self.mono_offset = time.time() - time.monotonic()
 
     def record(self, event: str, **fields) -> None:
-        self._buf.append((time.monotonic(), event, fields or None))
+        if "cluster" not in fields:
+            fields["cluster"] = 0  # host-level event
+        t = time.monotonic()
+        self._buf.append((t, event, fields))
+        ring = self._ring
+        if ring is not None:
+            try:
+                d = {"t": round(t, 6), "event": event}
+                d.update(fields)
+                ring.write(json.dumps(d, default=str, sort_keys=True).encode())
+            except Exception:
+                pass  # persistence must never break the producer
 
     def __len__(self) -> int:
         return len(self._buf)
@@ -215,20 +400,99 @@ class FlightRecorder:
     def reset(self) -> None:
         self._buf.clear()
 
-    def dump(self) -> List[dict]:
-        """Events oldest-first as plain dicts (t = monotonic seconds)."""
+    # ------------------------------------------------- persistent backing
+    def attach_mmap(
+        self, path: str, capacity: int = 4096, slot_size: int = 512
+    ) -> MmapRing:
+        """Tee every subsequent record() into a crash-persistent ring at
+        `path`. Idempotent for the same path — a NodeHost and the test
+        harness may both request it. A PRE-EXISTING ring file rotates to
+        `<path>.prev` first: the previous (possibly SIGKILL'd) process's
+        timeline is the artifact this feature exists to preserve, so a
+        restart's auto-attach (DRAGONBOAT_FLIGHT_RING, the pytest session
+        ring) must never truncate it — recover it any time from the .prev
+        file with read_mmap_ring. Rotation also keeps two co-located
+        processes handed the same path on separate inodes (the first
+        keeps writing its now-renamed mapping) instead of interleaving
+        seq counters in one file."""
+        ring = self._ring
+        if ring is not None and ring.path == path:
+            return ring
+        try:
+            with open(path, "rb") as f:
+                had_ring = f.read(len(_RING_MAGIC)) == _RING_MAGIC
+            if had_ring:
+                os.replace(path, path + ".prev")
+        except OSError:
+            pass  # no previous ring (or unreadable): nothing to preserve
+        new = MmapRing(path, capacity=capacity, slot_size=slot_size)
+        self._ring, old = new, ring
+        if old is not None:
+            old.close()
+        return new
+
+    def detach_mmap(self) -> None:
+        ring, self._ring = self._ring, None
+        if ring is not None:
+            ring.close()
+
+    def flush(self) -> None:
+        ring = self._ring
+        if ring is not None:
+            ring.flush()
+
+    # ------------------------------------------------------------- dumps
+    def _snapshot(self) -> list:
+        """Point-in-time copy of the deque that is safe against concurrent
+        record(): under free threading list(deque) can raise RuntimeError
+        ("deque mutated during iteration") — retry until a clean pass
+        (appends are tiny, so a clean pass comes within a few tries)."""
+        buf = self._buf
+        while True:
+            try:
+                return list(buf)
+            except RuntimeError:
+                continue
+
+    def dump(
+        self,
+        cluster_id: Optional[int] = None,
+        trace_id: Optional[int] = None,
+        event: Optional[str] = None,
+    ) -> List[dict]:
+        """Events oldest-first as plain dicts (t = monotonic seconds).
+        Server-side filters: cluster_id matches the event's `cluster`
+        field, trace_id the `trace` field, event the event name."""
         out = []
-        for t, event, fields in list(self._buf):
-            d = {"t": round(t, 6), "event": event}
+        for t, ev, fields in self._snapshot():
+            if event is not None and ev != event:
+                continue
+            if cluster_id is not None and fields.get("cluster") != cluster_id:
+                continue
+            if trace_id is not None and fields.get("trace") != trace_id:
+                continue
+            d = {"t": round(t, 6), "event": ev}
             if fields:
                 d.update(fields)
             out.append(d)
         return out
 
-    def to_jsonl(self) -> str:
-        return "\n".join(
-            json.dumps(d, default=str, sort_keys=True) for d in self.dump()
+    def to_jsonl(self, meta=None, **filters) -> str:
+        """JSONL dump; pass meta=True (or a dict of extra meta fields,
+        e.g. {"source": "node1"}) to prepend a `_meta` line carrying the
+        mono->wall offset the timeline CLI uses to merge multi-process
+        dumps onto one clock."""
+        lines = []
+        if meta:
+            m = {"event": "_meta", "mono_offset": round(self.mono_offset, 6)}
+            if isinstance(meta, dict):
+                m.update(meta)
+            lines.append(json.dumps(m, default=str, sort_keys=True))
+        lines.extend(
+            json.dumps(d, default=str, sort_keys=True)
+            for d in self.dump(**filters)
         )
+        return "\n".join(lines)
 
 
 # process-global recorder: every subsystem appends here so a test failure
@@ -248,5 +512,8 @@ __all__ = [
     "LatencySampler",
     "LatencyTrace",
     "FlightRecorder",
+    "MmapRing",
     "flight_recorder",
+    "mint_trace_id",
+    "read_mmap_ring",
 ]

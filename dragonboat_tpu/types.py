@@ -146,6 +146,11 @@ class Entry:
     series_id: int = 0
     responded_to: int = 0
     cmd: bytes = b""
+    # causal trace id (trace.mint_trace_id), nonzero on the 1-in-N sampled
+    # proposals only; unlike `lat` it IS serialized, so replicas across the
+    # wire can stamp the same id into their flight-recorder events and a
+    # merged multi-node dump reconstructs one proposal's causal chain
+    trace_id: int = 0
     # sampled latency trace (trace.LatencyTrace), attached at propose time
     # to 1-in-N proposals on the PROPOSING node only; never serialized (the
     # codec copies explicit fields), None everywhere else
@@ -310,6 +315,10 @@ class Message:
     reject: bool = False
     hint: int = 0
     hint_high: int = 0
+    # causal trace id carried across the wire AND the co-hosted delivery
+    # seam: stamped on Replicate/ReplicateResp hops that touch a sampled
+    # entry (0 everywhere else — the unsampled path pays nothing)
+    trace_id: int = 0
     entries: List[Entry] = field(default_factory=list)
     snapshot: Optional[Snapshot] = None
 

@@ -41,15 +41,69 @@ import pytest  # noqa: E402
 
 _HANG_DUMP_S = 600
 
+# ---- crash-persistent ring (the timeout-kill half of the forensics
+# story): JSONL failure dumps only happen when pytest survives to report —
+# a pytest-timeout / `timeout -k` SIGKILL leaves nothing. The session-wide
+# mmap ring persists every recorded event the moment it happens (mmap
+# pages live in the kernel page cache, so they survive ANY process death);
+# after a killed run, `python -m dragonboat_tpu.tools.timeline
+# .pytest_flight/live.ring` replays the tail, and the per-test
+# `_test_start` markers show which test was running when the axe fell. ----
+import atexit  # noqa: E402
+import signal  # noqa: E402
+
+
+def _flight_dump_dir() -> str:
+    d = os.environ.get("FLIGHT_DUMP_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", ".pytest_flight"
+    )
+    return os.path.abspath(d)
+
+
+def _attach_session_ring():
+    try:
+        from dragonboat_tpu.trace import flight_recorder
+
+        path = os.environ.get("FLIGHT_RING_PATH") or os.path.join(
+            _flight_dump_dir(), "live.ring"
+        )
+        rec = flight_recorder()
+        rec.attach_mmap(path)
+        atexit.register(rec.flush)
+        # `timeout -k` sends SIGTERM first: flush the ring and fall back
+        # to the default action so the artifact is complete even when the
+        # follow-up SIGKILL never becomes necessary
+        if signal.getsignal(signal.SIGTERM) in (
+            signal.SIG_DFL, signal.default_int_handler,
+        ):
+            def _on_term(signum, frame):
+                try:
+                    rec.flush()
+                finally:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+    except Exception:
+        pass  # forensics must never block the test run
+
+
+_attach_session_ring()
+
 
 def pytest_runtest_setup(item):
     faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=False)
     # fresh flight-recorder timeline per test: a failure dump must show
-    # THIS test's events, not the tail of whatever ran before it
+    # THIS test's events, not the tail of whatever ran before it. The
+    # mmap ring is NOT reset — it spans the session so a timeout kill
+    # keeps the recent cross-test tail; the marker delimits tests.
     try:
         from dragonboat_tpu.trace import flight_recorder
 
-        flight_recorder().reset()
+        rec = flight_recorder()
+        rec.reset()
+        # nodeid clipped so the marker always fits one mmap ring slot
+        rec.record("_test_start", nodeid=item.nodeid[-160:])
     except Exception:
         pass
 
@@ -90,7 +144,9 @@ def pytest_runtest_makereport(item, call):
         suffix = "" if rep.when == "call" else f"-{rep.when}"
         path = os.path.join(dump_dir, safe + suffix + ".jsonl")
         with open(path, "w") as f:
-            f.write(rec.to_jsonl() + "\n")
+            # the _meta header carries this process's mono->wall offset so
+            # tools.timeline can merge this dump with other hosts'/rings'
+            f.write(rec.to_jsonl(meta={"source": safe}) + "\n")
         tail = "\n".join(
             _json.dumps(e, default=str, sort_keys=True) for e in events[-25:]
         )

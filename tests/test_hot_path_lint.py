@@ -39,6 +39,7 @@ from __future__ import annotations
 import ast
 import inspect
 
+import dragonboat_tpu.engine.node as enode
 import dragonboat_tpu.engine.vector as vector
 import dragonboat_tpu.transport.transport as transport
 
@@ -78,6 +79,23 @@ HOT_TELEMETRY_FUNCTIONS = [
     (transport, "Transport", "send_many"),
     (transport, "_SendQueue", "put_many"),
     (transport, "_SendQueue", "_admit_locked"),
+]
+
+# functions where causal-trace stamping (mint_trace_id calls, .trace_id
+# attribute writes, flight-recorder .record appends) must sit behind the
+# sampling guard: the request entry points that mint, and the decode/send
+# phases that propagate. Unsampled requests must stay allocation- and
+# event-free (ISSUE 4: trace ids ride the sampled LatencyTrace path only).
+HOT_TRACE_FUNCTIONS = [
+    (enode, "Node", "propose"),
+    (enode, "Node", "propose_batch"),
+    (enode, "Node", "propose_batch_async"),
+    (enode, "Node", "apply_raft_update"),
+    (vector, None, "gather_replicate_sends"),
+    (vector, None, "gather_resp_sends"),
+    (vector, "VectorEngine", "_pack_wire"),
+    (vector, "VectorEngine", "_decode"),
+    (transport, "Transport", "send_many"),
 ]
 
 WHITELIST_MARK = "hot-path: ok"
@@ -169,7 +187,8 @@ def _lock_violations_in(fn_node, src_lines, first_lineno, fn_label):
 
 _TELEMETRY_CALLS = ("observe", "record")
 # identifier fragments that mark a sampling/latency gate in an `if` test
-_GUARD_HINTS = ("sampl", "lat", "sstats")
+# ("trace": trace-id truthiness gates — nonzero only on sampled requests)
+_GUARD_HINTS = ("sampl", "lat", "sstats", "trace")
 
 
 def _telemetry_violations_in(fn_node, src_lines, first_lineno, fn_label):
@@ -203,6 +222,64 @@ def _telemetry_violations_in(fn_node, src_lines, first_lineno, fn_label):
                     f"unguarded .{node.func.attr}() telemetry in a hot "
                     f"function: {line.strip()}"
                 )
+        for c in ast.iter_child_nodes(node):
+            visit(c, guarded)
+
+    visit(fn_node, False)
+    return out
+
+
+def _trace_violations_in(fn_node, src_lines, first_lineno, fn_label):
+    """Flag unguarded trace-id stamping in a hot function: mint_trace_id()
+    calls, `<x>.trace_id = ...` attribute writes, and flight-recorder
+    `.record(...)` appends must all sit under an `if` whose condition
+    references a sampling gate (sampler / latency trace / trace-id
+    truthiness). Everything else — including passing a zero trace id
+    through a constructor — is free and allowed."""
+    out = []
+
+    def guarded_by(test_node) -> bool:
+        dump = ast.dump(test_node).lower()
+        return any(h in dump for h in _GUARD_HINTS)
+
+    def flag(node, what):
+        line = src_lines[node.lineno - 1]
+        if WHITELIST_MARK not in line:
+            out.append(
+                f"{fn_label}:{first_lineno + node.lineno - 1}: "
+                f"unguarded {what} in a hot function: {line.strip()}"
+            )
+
+    def visit(node, guarded):
+        if isinstance(node, ast.If):
+            g = guarded or guarded_by(node.test)
+            for c in node.body:
+                visit(c, g)
+            for c in node.orelse:
+                visit(c, guarded)
+            return
+        if not guarded:
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = (
+                    fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else ""
+                )
+                if name == "mint_trace_id":
+                    flag(node, "mint_trace_id() call")
+                elif name in _TELEMETRY_CALLS and isinstance(
+                    fn, ast.Attribute
+                ):
+                    flag(node, f".{name}() telemetry")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "trace_id":
+                        flag(node, ".trace_id stamp")
         for c in ast.iter_child_nodes(node):
             visit(c, guarded)
 
@@ -266,6 +343,42 @@ def test_hot_path_telemetry_is_sampling_guarded():
             _telemetry_violations_in(fn_node, src_lines, first_lineno, label)
         )
     assert not problems, "\n".join(problems)
+
+
+def test_trace_stamping_is_sampling_guarded():
+    problems = []
+    for module, cls_name, fn_name in HOT_TRACE_FUNCTIONS:
+        label = f"{cls_name + '.' if cls_name else ''}{fn_name}"
+        try:
+            fn = _resolve(cls_name, fn_name, module)
+        except AttributeError:
+            problems.append(
+                f"{label}: hot function no longer exists — update the "
+                f"HOT_TRACE_FUNCTIONS list"
+            )
+            continue
+        fn_node, (src_lines, first_lineno) = _function_ast(fn)
+        problems.extend(
+            _trace_violations_in(fn_node, src_lines, first_lineno, label)
+        )
+    assert not problems, "\n".join(problems)
+
+
+def test_trace_lint_catches_regressions():
+    bad_src = (
+        "def f(self, entry):\n"
+        "    entry.trace_id = mint_trace_id()\n"  # BANNED x2 (unguarded)
+        "    recorder.record('propose_enqueue', trace=entry.trace_id)\n"  # BANNED
+        "    if self._req_sampler.sample():\n"
+        "        entry.trace_id = mint_trace_id()\n"  # guarded: fine
+        "        recorder.record('propose_enqueue')\n"  # guarded: fine
+        "    if entry.trace_id:\n"
+        "        recorder.record('replicate_send')\n"  # trace-gated: fine
+    )
+    tree = ast.parse(bad_src)
+    lines = bad_src.split("\n")
+    got = _trace_violations_in(tree.body[0], lines, 1, "f")
+    assert len(got) == 3, got
 
 
 def test_telemetry_lint_catches_regressions():

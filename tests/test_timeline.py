@@ -1,0 +1,95 @@
+"""tools.timeline tests: multi-dump merge by mono-offset negotiation,
+filtering, causal-chain rendering, and the CLI smoke test over the
+checked-in two-node fixture dump (tests/data/timeline_node*.jsonl — a
+leader-side dump and a follower-side dump whose raw monotonic clocks are
+4.5s apart; only the negotiated offsets interleave them correctly)."""
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+from dragonboat_tpu.tools import timeline
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+N1 = os.path.join(DATA, "timeline_node1.jsonl")
+N2 = os.path.join(DATA, "timeline_node2.jsonl")
+TRACE = 0x0123456789ABCDEF
+
+
+def test_merge_negotiates_clock_offsets():
+    merged = timeline.merge_dumps([N1, N2])
+    assert [e["event"] for e in merged] == [
+        "leader_changed",       # n1 wall 1000.9
+        "partition_window",     # n2 wall 1000.9 (raw t=5.4!)
+        "propose_enqueue",      # n1 wall 1001.000001
+        "replicate_send",       # n1 wall 1001.0004
+        "replicate_recv",       # n2 wall 1001.001 — between send and commit
+        "replicate_ack",        # n2 wall 1001.0015
+        "quorum_commit",        # n1 wall 1001.0021
+        "proposal_applied",     # n1 wall 1001.0026
+    ]
+    # raw t ordering would have been wrong (n2's monotonic base differs)
+    raw = sorted(merged, key=lambda e: e["t"])
+    assert [e["event"] for e in raw] != [e["event"] for e in merged]
+    assert {e["_src"] for e in merged} == {"n1", "n2"}
+
+
+def test_filters_and_chains():
+    merged = timeline.merge_dumps([N1, N2])
+    only_group = timeline.filter_events(merged, cluster=2)
+    assert all(e["cluster"] == 2 for e in only_group)
+    assert len(only_group) == len(merged) - 1  # partition_window is host-level
+    by_kind = timeline.filter_events(merged, kinds={"replicate_recv"})
+    assert len(by_kind) == 1 and by_kind[0]["node"] == 2
+    chains = timeline.causal_chains(merged)
+    assert set(chains) == {TRACE}
+    chain = chains[TRACE]
+    assert [e["event"] for e in chain] == [
+        "propose_enqueue", "replicate_send", "replicate_recv",
+        "replicate_ack", "quorum_commit", "proposal_applied",
+    ]
+    assert {e["node"] for e in chain} == {1, 2}
+
+
+def _run_cli(args):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = timeline.main(args)
+    return rc, buf.getvalue()
+
+
+def test_cli_smoke_over_fixture_dump():
+    rc, out = _run_cli([N1, N2])
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 8
+    assert lines[0].startswith("+") and "[n1] leader_changed" in lines[0]
+    assert "replicate_recv" in out and "[n2]" in out
+
+    rc, out = _run_cli([N1, N2, "--chains"])
+    assert rc == 0
+    assert f"trace {TRACE:#x}" in out
+    assert "nodes [1, 2]" in out
+    assert out.index("propose_enqueue") < out.index("quorum_commit")
+
+    rc, out = _run_cli(
+        [N1, N2, "--trace", hex(TRACE), "--event", "quorum_commit", "--json"]
+    )
+    assert rc == 0
+    rows = [json.loads(ln) for ln in out.splitlines() if ln.strip()]
+    assert len(rows) == 1
+    assert rows[0]["event"] == "quorum_commit"
+    assert rows[0]["trace"] == TRACE
+
+    rc, out = _run_cli([N1, "--cluster", "2", "--event", "nonexistent"])
+    assert rc == 0
+    assert "(no events)" in out
+
+
+def test_cli_handles_torn_tail_lines(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    with open(N1) as f:
+        content = f.read()
+    p.write_text(content + '{"t": 9.9, "event": "trunc')  # torn tail
+    merged = timeline.merge_dumps([str(p)])
+    assert len(merged) == 5  # meta consumed, torn line skipped
