@@ -1,0 +1,89 @@
+"""`dragonboat_tpu.analysis`: the static-analysis subsystem.
+
+A pure-AST rule engine (no imports of the checked code, no jax in the
+process) plus three analyzer families for the two failure classes that
+keep biting this architecture:
+
+  * silent hot-path regressions — device syncs and recompilation hazards
+    on the compiled JAX step loop (`device-sync/*`, `retrace/*`, plus the
+    four hot-path families migrated from tests/test_hot_path_lint.py:
+    `columnar/*`, `locks/lock-in-hot-loop`, `telemetry/unguarded`,
+    `trace/unguarded-stamp`);
+  * host-side lock-discipline races — a declared lock hierarchy and
+    guarded-state map checked lexically (`locks/order`,
+    `locks/guarded-state`).
+
+Entry points:
+
+    python -m dragonboat_tpu.tools.check [--json] [paths...]
+    from dragonboat_tpu.analysis import build_analyzer
+    findings = build_analyzer().run()
+
+Suppression: `# lint: allow(<rule-or-family>) <reason>` on the flagged
+line (or alone on the line above). See engine.py for pragma semantics.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+from .engine import (
+    Analyzer,
+    Finding,
+    FunctionInfo,
+    LEGACY_MARK,
+    Rule,
+    SourceModule,
+    unsuppressed,
+)
+from .targets import DEFAULT_TARGETS, LockSpec, Targets
+from . import rules_device, rules_hotpath, rules_locks, rules_retrace
+
+#: every registered rule, in family order (hotpath -> device -> retrace
+#: -> locks); tools.check --list-rules renders this table
+ALL_RULES: List[Rule] = (
+    list(rules_hotpath.RULES)
+    + list(rules_device.RULES)
+    + list(rules_retrace.RULES)
+    + list(rules_locks.RULES)
+)
+
+FAMILIES = sorted({r.id.split("/", 1)[0] for r in ALL_RULES})
+
+
+def rules_for_families(families: Iterable[str]) -> List[Rule]:
+    fams = set(families)
+    return [r for r in ALL_RULES if r.id.split("/", 1)[0] in fams]
+
+
+def build_analyzer(
+    families: Optional[Sequence[str]] = None,
+    targets: Targets = DEFAULT_TARGETS,
+    root: str = "",
+) -> Analyzer:
+    """The standard analyzer over the dragonboat_tpu package root; narrow
+    to specific rule families with `families=("columnar", "locks")`."""
+    rules = ALL_RULES if families is None else rules_for_families(families)
+    return Analyzer(rules, targets, root=root)
+
+
+def run_default(paths: Optional[Sequence[str]] = None) -> List[Finding]:
+    return build_analyzer().run(paths)
+
+
+__all__ = [
+    "ALL_RULES",
+    "Analyzer",
+    "DEFAULT_TARGETS",
+    "FAMILIES",
+    "Finding",
+    "FunctionInfo",
+    "LEGACY_MARK",
+    "LockSpec",
+    "Rule",
+    "SourceModule",
+    "Targets",
+    "build_analyzer",
+    "rules_for_families",
+    "run_default",
+    "unsuppressed",
+]

@@ -1,0 +1,428 @@
+"""The rule engine under `dragonboat_tpu.analysis`.
+
+Pure-AST static analysis: modules are PARSED, never imported, so the
+checker runs in milliseconds with no jax (or any other dependency) in the
+process, and `python -m dragonboat_tpu.tools.check` can gate CI before a
+single kernel compiles.
+
+Building blocks:
+
+  * `SourceModule`  — one parsed file: source lines, AST, the function
+    table (qualnames like `VectorEngine._decode`, nested defs like
+    `make_step_fn.apply`), the single-level class->bases map, and the
+    suppression pragmas scanned from the raw lines.
+  * `FunctionInfo`  — one function with its qualname, enclosing class and
+    a back-pointer to the module; rules receive these.
+  * `Rule`          — one check: `id` ("family/name"), `doc`, `motivation`
+    (which real bug/PR the rule exists for), and `check_function()`
+    yielding findings. The family prefix groups rules for suppression
+    (`# lint: allow(family)`) and for the conformance shim.
+  * `Analyzer`      — walks files -> modules -> functions -> rules,
+    applies suppressions, dedupes, and reports configuration drift
+    (a targeted function that no longer exists is itself a finding:
+    a silently-unenforced rule is how regressions sneak back in).
+
+Suppression pragmas:
+
+    x = arr[g].item()  # lint: allow(columnar/item-in-loop) rare lane, <=1/step
+
+A pragma allows a rule id, a whole family (`allow(device-sync)`), a
+comma-separated list, or `*`. It applies to findings on its own line, or
+— when the line holds only the pragma comment — to the line below. Every
+suppression must carry a reason; a bare `allow(...)` is itself reported
+(`pragma/missing-reason`). The legacy `# hot-path: ok` mark from the old
+test-embedded lint keeps working for the four migrated hot-path families.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import textwrap
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# families the pre-analysis `# hot-path: ok` mark (tests/test_hot_path_lint
+# .py) may suppress — kept so existing in-tree marks migrate untouched
+LEGACY_MARK = "hot-path: ok"
+LEGACY_MARK_FAMILIES = ("columnar", "locks", "telemetry", "trace")
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)\s*(.*)$")
+
+# identifier fragments that mark a sampling/latency gate in an `if` test
+# ("trace": trace-id truthiness gates — nonzero only on sampled requests)
+GUARD_HINTS = ("sampl", "lat", "sstats", "trace")
+
+
+def guard_test_is_sampling_gate(test_node: ast.AST) -> bool:
+    """True when an `if` condition references a sampling/latency gate."""
+    dump = ast.dump(test_node).lower()
+    return any(h in dump for h in GUARD_HINTS)
+
+
+@dataclass
+class Finding:
+    """One reported violation. `suppressed` findings stay in the output
+    (visible in --json and `--show-suppressed`) but do not fail the run."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+        }
+
+    def render(self) -> str:
+        tail = f"  [suppressed: {self.suppress_reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tail}"
+
+
+@dataclass
+class _Pragma:
+    rules: Tuple[str, ...]
+    reason: str
+    standalone: bool  # the line holds only the comment -> applies below
+
+
+class FunctionInfo:
+    """One function/method with enough context for a rule to act on."""
+
+    __slots__ = ("qualname", "name", "class_name", "node", "module")
+
+    def __init__(self, qualname, name, class_name, node, module) -> None:
+        self.qualname = qualname
+        self.name = name
+        self.class_name = class_name  # nearest enclosing class, or None
+        self.node = node
+        self.module = module
+
+    def line(self, node: ast.AST) -> str:
+        try:
+            return self.module.lines[node.lineno - 1]
+        except IndexError:
+            return ""
+
+    def key(self) -> Tuple[str, str]:
+        return (self.module.relpath, self.qualname)
+
+
+class SourceModule:
+    """A parsed source file plus the lookup tables rules need."""
+
+    def __init__(self, source: str, relpath: str, path: str = "") -> None:
+        self.relpath = relpath  # package-relative, "/"-separated
+        self.path = path or relpath  # display path for findings
+        self.lines = source.split("\n")
+        self.tree = ast.parse(source)
+        self.functions: List[FunctionInfo] = []
+        self.class_bases: Dict[str, List[str]] = {}
+        self.pragmas: Dict[int, _Pragma] = {}
+        self._collect_functions()
+        self._scan_pragmas()
+
+    @classmethod
+    def from_file(cls, path: str, relpath: str) -> "SourceModule":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls(f.read(), relpath, path)
+
+    @classmethod
+    def from_snippet(cls, source: str, relpath: str = "snippet.py") -> "SourceModule":
+        return cls(textwrap.dedent(source), relpath)
+
+    # -- structure ---------------------------------------------------------
+    def _collect_functions(self) -> None:
+        def visit(node, prefix: str, class_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    self.class_bases[child.name] = [
+                        b.id for b in child.bases if isinstance(b, ast.Name)
+                    ]
+                    visit(child, prefix + child.name + ".", child.name)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = prefix + child.name
+                    self.functions.append(
+                        FunctionInfo(qn, child.name, class_name, child, self)
+                    )
+                    visit(child, qn + ".", class_name)
+                else:
+                    # defs can hide inside any statement (with/if/try):
+                    # keep the prefix and keep looking
+                    visit(child, prefix, class_name)
+
+        visit(self.tree, "", None)
+
+    def function(self, qualname: str) -> Optional[FunctionInfo]:
+        for fn in self.functions:
+            if fn.qualname == qualname:
+                return fn
+        return None
+
+    def is_subclass_of(self, cls: Optional[str], base: str) -> bool:
+        """Single-level-per-hop base walk within this module."""
+        seen = set()
+        while cls is not None and cls not in seen:
+            if cls == base:
+                return True
+            seen.add(cls)
+            bases = self.class_bases.get(cls, [])
+            cls = bases[0] if bases else None
+        return False
+
+    # -- suppression -------------------------------------------------------
+    def _scan_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m is None:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            reason = m.group(2).strip()
+            standalone = line.strip().startswith("#")
+            if not standalone:
+                self.pragmas[i] = _Pragma(rules, reason, False)
+                continue
+            # a standalone pragma covers the next CODE line; comment lines
+            # in between continue the reason text
+            j = i + 1
+            while j <= len(self.lines):
+                nxt = self.lines[j - 1].strip()
+                if nxt.startswith("#"):
+                    reason = (reason + " " + nxt.lstrip("# ")).strip()
+                    j += 1
+                elif not nxt:
+                    j += 1
+                else:
+                    break
+            if j <= len(self.lines):
+                self.pragmas.setdefault(j, _Pragma(rules, reason, True))
+
+    def suppression_for(self, rule_id: str, line: int) -> Optional[_Pragma]:
+        """Pragma covering `rule_id` at `line`: on the same line, or a
+        standalone pragma comment directly above (continuation comment
+        lines extend the reason)."""
+        family = rule_id.split("/", 1)[0]
+        p = self.pragmas.get(line)
+        if p is not None:
+            for r in p.rules:
+                if r in ("*", rule_id, family):
+                    return p
+        if family in LEGACY_MARK_FAMILIES and 0 < line <= len(self.lines):
+            if LEGACY_MARK in self.lines[line - 1]:
+                return _Pragma(("*",), "legacy hot-path: ok mark", False)
+        return None
+
+
+class Rule:
+    """Base class: one named check over one function."""
+
+    id: str = ""
+    doc: str = ""  # one line: what it catches
+    motivation: str = ""  # which real bug / PR motivated it
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # -- shared AST helpers ------------------------------------------------
+    @staticmethod
+    def loop_body_nodes(fn_node: ast.AST):
+        """Yield (loop, sub) for every node inside a for/while BODY (the
+        iterator expression runs once and is exempt — column-level
+        `.tolist()` there is the fast idiom)."""
+        for node in ast.walk(fn_node):
+            if isinstance(node, (ast.For, ast.While)):
+                for stmt in node.body + node.orelse:
+                    for sub in ast.walk(stmt):
+                        yield node, sub
+
+    def finding(self, fn: FunctionInfo, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            path=fn.module.path,
+            line=line,
+            message=f"{fn.qualname}: {message}",
+            snippet=fn.line(node).strip(),
+        )
+
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Analyzer:
+    """Runs a rule set over a file tree and applies suppressions."""
+
+    def __init__(self, rules: Sequence[Rule], targets, root: str = "") -> None:
+        self.rules = list(rules)
+        self.targets = targets
+        self.root = root or _PKG_ROOT
+
+    # -- discovery ---------------------------------------------------------
+    def _iter_files(self, paths: Optional[Sequence[str]]):
+        """Yield ("file", path) plus ("missing", path) markers: an explicit
+        path that matches NOTHING must fail loudly — a typo'd path in CI
+        would otherwise report a permanently-clean gate that checks
+        nothing (the exact silently-unenforced failure mode
+        config/missing-target exists to prevent). Relative paths that do
+        not exist from the cwd are retried against the analyzer root, so
+        `tools.check engine/ storage/` works from anywhere."""
+        if not paths:
+            paths = [self.root]
+        for p in paths:
+            if not os.path.exists(p):
+                rooted = os.path.join(self.root, p)
+                if os.path.exists(rooted):
+                    p = rooted
+                else:
+                    yield ("missing", p)
+                    continue
+            if os.path.isdir(p):
+                matched = False
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [
+                        d for d in dirnames
+                        if d != "__pycache__" and not d.startswith(".")
+                    ]
+                    for fname in sorted(filenames):
+                        if fname.endswith(".py"):
+                            matched = True
+                            yield ("file", os.path.join(dirpath, fname))
+                if not matched:
+                    yield ("missing", p)
+            elif p.endswith(".py"):
+                yield ("file", p)
+            else:
+                yield ("missing", p)
+
+    def _relpath(self, path: str) -> str:
+        rp = os.path.relpath(os.path.abspath(path), self.root)
+        return rp.replace(os.sep, "/")
+
+    # -- run ---------------------------------------------------------------
+    def run(self, paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_functions: Set[Tuple[str, str]] = set()
+        seen_modules: Set[str] = set()
+        for kind, path in self._iter_files(paths):
+            if kind == "missing":
+                findings.append(
+                    Finding(
+                        "config/no-such-path",
+                        path,
+                        1,
+                        "path matches no Python files — a typo here would "
+                        "make the gate silently check nothing",
+                    )
+                )
+                continue
+            relpath = self._relpath(path)
+            try:
+                mod = SourceModule.from_file(path, relpath)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                findings.append(
+                    Finding("config/unparseable", path, 1, f"cannot parse: {e}")
+                )
+                continue
+            seen_modules.add(relpath)
+            for fn in mod.functions:
+                seen_functions.add(fn.key())
+            findings.extend(self.run_module(mod))
+        findings.extend(
+            self._config_drift(seen_modules, seen_functions)
+        )
+        return findings
+
+    def run_module(self, mod: SourceModule) -> List[Finding]:
+        out: List[Finding] = []
+        dedup: Set[Tuple[str, int, str]] = set()
+        for fn in mod.functions:
+            for rule in self.rules:
+                for f in rule.check_function(fn, self.targets):
+                    key = (f.rule, f.line, f.message)
+                    if key in dedup:
+                        continue
+                    dedup.add(key)
+                    self._apply_suppression(mod, f, out, dedup)
+                    out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
+    def run_snippet(
+        self, source: str, relpath: str = "snippet.py"
+    ) -> List[Finding]:
+        return self.run_module(SourceModule.from_snippet(source, relpath))
+
+    def _apply_suppression(
+        self, mod: SourceModule, f: Finding, out: List[Finding], dedup
+    ) -> None:
+        p = mod.suppression_for(f.rule, f.line)
+        if p is None:
+            return
+        f.suppressed = True
+        f.suppress_reason = p.reason or "(no reason given)"
+        if not p.reason:
+            msg = (
+                "suppression carries no reason — every allow() must say why"
+            )
+            key = ("pragma/missing-reason", f.line, msg)
+            if key not in dedup:
+                dedup.add(key)
+                out.append(
+                    Finding("pragma/missing-reason", f.path, f.line, msg)
+                )
+
+    def _config_drift(
+        self, seen_modules: Set[str], seen_functions: Set[Tuple[str, str]]
+    ) -> List[Finding]:
+        """A targeted function that no longer exists means a rule silently
+        stopped firing — that is a finding, exactly like the legacy lint's
+        'update the HOT_FUNCTIONS list' failure."""
+        missing: Dict[Tuple[str, str], List[str]] = {}
+        for relpath, qualname, why in self.targets.all_function_targets():
+            if relpath in seen_modules and (relpath, qualname) not in seen_functions:
+                missing.setdefault((relpath, qualname), []).append(why)
+        out = []
+        for (relpath, qualname), whys in sorted(missing.items()):
+            out.append(
+                Finding(
+                    "config/missing-target",
+                    relpath,
+                    1,
+                    f"{qualname}: targeted by {', '.join(whys)} but no "
+                    f"longer exists — update analysis/targets.py (and "
+                    f"keep its replacement covered)",
+                )
+            )
+        return out
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+__all__ = [
+    "Analyzer",
+    "Finding",
+    "FunctionInfo",
+    "GUARD_HINTS",
+    "LEGACY_MARK",
+    "Rule",
+    "SourceModule",
+    "guard_test_is_sampling_gate",
+    "unsuppressed",
+]

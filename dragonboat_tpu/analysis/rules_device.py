@@ -1,0 +1,232 @@
+"""Device-sync detection: implicit device->host transfers on the hot path.
+
+The engine's step loop is architected around exactly ONE device->host
+transfer per step (`VectorEngine._fetch_output`: a single consolidated
+`jax.device_get` of the whole StepOutput). Everything after it works on
+host numpy mirrors. Any OTHER transfer in a hot function — an explicit
+`jax.device_get`, a `.block_until_ready()`, an `np.asarray(...)` /
+`float()/int()/bool()` coercion of a device value, or scalar indexing of
+a device array inside a loop — blocks the async dispatch pipeline and
+silently reintroduces the per-step sync the columnar refactor removed.
+No test fails; the BENCH numbers just quietly decay.
+
+Device values are recognized by dotted-prefix roots declared in
+`targets.device_roots` (the engine's device state lives under
+`self._state`); the heuristic is deliberately narrow — a false negative
+costs a missed review comment, a false positive costs everyone a pragma.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .engine import Finding, FunctionInfo, Rule
+
+_SYNC_ATTR_CALLS = ("block_until_ready",)
+_COERCIONS = ("int", "float", "bool")
+
+
+def dotted_parts(expr: ast.AST) -> Optional[List[str]]:
+    """`self._state.term[g]` -> ["self", "_state", "term"]; None when the
+    expression is not a name/attribute/subscript chain."""
+    parts: List[str] = []
+    node = expr
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return list(reversed(parts))
+        else:
+            return None
+
+
+def _rooted_in(expr: ast.AST, roots) -> bool:
+    parts = dotted_parts(expr)
+    if parts is None:
+        return False
+    dotted = ".".join(parts)
+    return any(dotted == r or dotted.startswith(r + ".") for r in roots)
+
+
+def _mentions_device_root(expr: ast.AST, roots) -> bool:
+    """Any sub-expression rooted in a declared device root."""
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Attribute, ast.Name, ast.Subscript)):
+            if _rooted_in(node, roots):
+                return True
+    return False
+
+
+class DeviceGetOutsideSeam(Rule):
+    id = "device-sync/device-get"
+    doc = (
+        "jax.device_get()/.block_until_ready() in a hot function outside "
+        "the blessed _fetch_output seam — a second per-step transfer "
+        "stalls the async dispatch pipeline"
+    )
+    motivation = (
+        "PR 1: the step loop pays exactly one consolidated device->host "
+        "fetch; extra syncs erase the columnar win without failing a test"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if fn.key() not in targets.hot_functions:
+            return
+        if fn.key() in targets.blessed_device_get:
+            return
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "device_get":
+                    yield self.finding(
+                        fn,
+                        node,
+                        "device_get outside the blessed _fetch_output seam",
+                    )
+                elif f.attr in _SYNC_ATTR_CALLS:
+                    yield self.finding(
+                        fn, node, f".{f.attr}() forces a device sync"
+                    )
+            elif isinstance(f, ast.Name) and f.id == "device_get":
+                yield self.finding(
+                    fn,
+                    node,
+                    "device_get outside the blessed _fetch_output seam",
+                )
+
+
+class HostCoercionOfDeviceValue(Rule):
+    id = "device-sync/scalar-read"
+    doc = (
+        "float()/int()/bool()/.item() applied to a device value "
+        "(targets.device_roots) in a hot function — each coercion is one "
+        "blocking device->host transfer"
+    )
+    motivation = (
+        "PR 1: scalar reads of device arrays were the per-message host "
+        "work the whole-column gathers removed"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if fn.key() not in targets.hot_functions:
+            return
+        roots = targets.device_roots
+        if not roots:
+            return
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _COERCIONS
+                and node.args
+                and _mentions_device_root(node.args[0], roots)
+            ):
+                yield self.finding(
+                    fn,
+                    node,
+                    f"{f.id}() on a device value is an implicit "
+                    f"device->host sync",
+                )
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr == "item"
+                and _mentions_device_root(f.value, roots)
+            ):
+                yield self.finding(
+                    fn,
+                    node,
+                    ".item() on a device value is an implicit "
+                    "device->host sync",
+                )
+
+
+class AsarrayOnDeviceValue(Rule):
+    id = "device-sync/host-array"
+    doc = (
+        "np.asarray()/np.array() of a device value in a hot function — a "
+        "whole-plane implicit transfer outside the consolidated fetch"
+    )
+    motivation = (
+        "PR 1: plane fetches belong in _fetch_output where they ship as "
+        "ONE batched transfer; ad-hoc np.asarray pulls add per-dispatch "
+        "overhead and block the pipeline"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if fn.key() not in targets.hot_functions:
+            return
+        roots = targets.device_roots
+        if not roots:
+            return
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("asarray", "array")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy")
+                and node.args
+                and _mentions_device_root(node.args[0], roots)
+            ):
+                yield self.finding(
+                    fn,
+                    node,
+                    f"np.{f.attr}() on a device value is an implicit "
+                    f"whole-plane transfer",
+                )
+
+
+class DeviceScalarIndexInLoop(Rule):
+    id = "device-sync/index-in-loop"
+    doc = (
+        "scalar indexing of a device array inside a for/while body of a "
+        "hot function — O(iterations) device round-trips"
+    )
+    motivation = (
+        "PR 1: per-lane device reads in the fan-out loops were the "
+        "measured hot spot the numpy mirrors replaced"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if fn.key() not in targets.hot_functions:
+            return
+        roots = targets.device_roots
+        if not roots:
+            return
+        for _loop, sub in self.loop_body_nodes(fn.node):
+            if isinstance(sub, ast.Subscript) and _rooted_in(
+                sub.value, roots
+            ):
+                yield self.finding(
+                    fn,
+                    sub,
+                    "device-array indexing inside a hot loop (gather the "
+                    "column once outside the loop)",
+                )
+
+
+RULES = [
+    DeviceGetOutsideSeam(),
+    HostCoercionOfDeviceValue(),
+    AsarrayOnDeviceValue(),
+    DeviceScalarIndexInLoop(),
+]
+
+__all__ = [
+    "RULES",
+    "AsarrayOnDeviceValue",
+    "DeviceGetOutsideSeam",
+    "DeviceScalarIndexInLoop",
+    "HostCoercionOfDeviceValue",
+    "dotted_parts",
+]

@@ -1,0 +1,313 @@
+"""Recompilation-hazard detection for the jitted step path.
+
+The whole architecture rests on ONE compiled executable advancing every
+lane (`make_step_fn` is lru_cached per KernelConfig). Three ways that
+silently breaks, each a rule here:
+
+  * Python control flow on a traced value inside kernel code — under
+    `jax.jit` an `if`/`while` on a tracer either raises at trace time or,
+    when the value sneaks in as a weak type, forks the trace per call.
+  * Concretizing a traced value (`int()/float()/bool()/np.asarray()`)
+    inside kernel code — forces a trace-time constant, so the compiled
+    step is only valid for that value and every new value retraces.
+  * Creating jit wrappers inside the step loop's hot functions — each
+    `jax.jit(...)` call is a fresh cache, so per-step creation compiles
+    forever (the blessed pattern is the lru_cached factory:
+    `make_step_fn` / `_make_activate_fn`).
+
+Tracedness is declared in targets (`traced_modules` / `traced_functions`)
+and propagated through simple assignments. Static escapes — `.shape`,
+`.dtype`, `.ndim`, `len()` — do NOT taint: those are Python values at
+trace time and branching on them is exactly how shape-specialized kernels
+are supposed to be written.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .engine import Finding, FunctionInfo, Rule
+
+_STATIC_ATTRS = ("shape", "dtype", "ndim", "size")
+_CONCRETIZERS = ("int", "float", "bool")
+_JIT_FACTORIES = ("make_step_fn", "_make_activate_fn")
+
+
+def _static_escaped_names(expr: ast.AST) -> Set[int]:
+    """ids of Name nodes that only feed static accessors (x.shape, len(x))
+    — referencing a traced array through them is trace-stable."""
+    escaped: Set[int] = set()
+
+    def mark(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                escaped.add(id(sub))
+
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            mark(node.value)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
+            for a in node.args:
+                mark(a)
+    return escaped
+
+
+def _traced_refs(expr: ast.AST, traced: Set[str]) -> bool:
+    """Does `expr` reference a traced name outside a static escape?
+
+    `x is y` / `x is not y` never reads a traced VALUE — identity of the
+    tracer objects is a Python-level property, stable per call site (the
+    `_merge.sel` fast path relies on it) — so pure identity comparisons
+    are exempt."""
+    if isinstance(expr, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+    ):
+        return False
+    escaped = _static_escaped_names(expr)
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Name)
+            and node.id in traced
+            and id(node) not in escaped
+        ):
+            return True
+    return False
+
+
+def _traced_name_set(fn: FunctionInfo, targets) -> Set[str]:
+    """Seed with non-static parameters, then propagate through simple
+    assignments to a FIXPOINT: ast.walk order is not source order (an
+    assignment inside a loop body is visited after later top-level
+    statements), so one pass would miss taint flowing out of nested
+    blocks. The pass count is bounded by the assignment-chain depth."""
+    args = fn.node.args
+    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    n_defaults = len(args.defaults)
+    defaulted = set(
+        a.arg for a in (args.posonlyargs + args.args)[-n_defaults:]
+    ) if n_defaults else set()
+    defaulted |= {a.arg for a in args.kwonlyargs}
+    traced = {
+        p
+        for p in params
+        if p not in targets.static_param_names
+        and p not in defaulted
+        and p != "self"
+    }
+    while True:
+        before = len(traced)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and _traced_refs(
+                node.value, traced
+            ):
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            traced.add(sub.id)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                if _traced_refs(node.value, traced):
+                    traced.add(node.target.id)
+        if len(traced) == before:
+            return traced
+
+
+class PythonBranchOnTraced(Rule):
+    id = "retrace/python-branch-on-traced"
+    doc = (
+        "Python if/while (or iteration) on a value derived from a traced "
+        "array inside jitted kernel code — trace-time error or a fresh "
+        "trace per call; use jnp.where/lax.cond masks"
+    )
+    motivation = (
+        "the kernel advances all lanes divergence-free by construction "
+        "(ops/kernel.py); one Python branch on device data breaks the "
+        "single-executable contract"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if not targets.is_traced(fn.key()):
+            return
+        traced = _traced_name_set(fn, targets)
+        if not traced:
+            return
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.If, ast.While)):
+                if _traced_refs(node.test, traced):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        fn,
+                        node,
+                        f"Python `{kind}` on a traced value (mask with "
+                        f"jnp.where / lax.cond instead)",
+                    )
+            elif isinstance(node, ast.For):
+                if _traced_refs(node.iter, traced):
+                    yield self.finding(
+                        fn,
+                        node,
+                        "Python iteration over a traced value (use "
+                        "lax.scan / vectorized ops)",
+                    )
+            elif isinstance(node, ast.IfExp):
+                if _traced_refs(node.test, traced):
+                    yield self.finding(
+                        fn,
+                        node,
+                        "conditional expression on a traced value (use "
+                        "jnp.where)",
+                    )
+
+
+class ConcretizeTraced(Rule):
+    id = "retrace/concretize-traced"
+    doc = (
+        "int()/float()/bool()/np.asarray() on a traced value inside "
+        "jitted kernel code — bakes a trace-time constant, so every new "
+        "value recompiles"
+    )
+    motivation = (
+        "a float static arg / concretized scalar gives the jit cache a "
+        "per-call signature: the compile-once contract degrades to "
+        "compile-per-value with no test failing"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if not targets.is_traced(fn.key()):
+            return
+        traced = _traced_name_set(fn, targets)
+        if not traced:
+            return
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = ""
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ):
+                if f.value.id in ("np", "numpy") and f.attr in (
+                    "asarray",
+                    "array",
+                ):
+                    name = f"np.{f.attr}"
+            if not name:
+                continue
+            if name in _CONCRETIZERS or name.startswith("np."):
+                if node.args and _traced_refs(node.args[0], traced):
+                    yield self.finding(
+                        fn,
+                        node,
+                        f"{name}() concretizes a traced value (trace-time "
+                        f"constant -> retrace per value)",
+                    )
+
+
+class JitInHotFunction(Rule):
+    id = "retrace/jit-in-hot"
+    doc = (
+        "jax.jit()/jit-factory call inside a step-loop hot function — a "
+        "fresh wrapper (and XLA compile) per step; build wrappers once in "
+        "the lru_cached factories"
+    )
+    motivation = (
+        "eagerly-created scatter chains at bring-up dominated wall clock "
+        "until _make_activate_fn bucketed + cached them; the step loop "
+        "must never create jit wrappers"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if fn.key() not in targets.hot_functions:
+            return
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "jit":
+                yield self.finding(
+                    fn, node, "jax.jit() wrapper created on the hot path"
+                )
+            elif isinstance(f, ast.Name) and f.id in ("jit",) + tuple(
+                _JIT_FACTORIES
+            ):
+                # the factories are lru_cached, but calling them per step
+                # still pays a config-hash + risks a compile on any miss
+                yield self.finding(
+                    fn,
+                    node,
+                    f"{f.id}() called on the hot path — resolve the "
+                    f"compiled fn once at setup",
+                )
+
+
+class DictIterInTraced(Rule):
+    id = "retrace/dict-iter-in-traced"
+    doc = (
+        "iterating .items()/.keys()/.values() of a non-literal dict "
+        "inside jitted kernel code — trace structure depends on dict "
+        "insertion order (a reordered caller silently recompiles)"
+    )
+    motivation = (
+        "dict-ordering-dependent closures are the classic invisible "
+        "trace-signature variance: same values, different order, new "
+        "executable"
+    )
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        if not targets.is_traced(fn.key()):
+            return
+        # a dict ASSIGNED inside this function has program-text-determined
+        # insertion order — deterministic per trace. The hazard is order
+        # chosen by someone else: parameters and closure captures.
+        local_names = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            local_names.add(sub.id)
+        for node in ast.walk(fn.node):
+            iters = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute)
+                    and it.func.attr in ("items", "keys", "values")
+                    and isinstance(it.func.value, ast.Name)
+                    and it.func.value.id not in local_names
+                ):
+                    yield self.finding(
+                        fn,
+                        node,
+                        f"iteration over {it.func.value.id}."
+                        f"{it.func.attr}() — trace shape depends on dict "
+                        f"ordering; iterate a sorted/declared key list",
+                    )
+
+
+RULES = [
+    PythonBranchOnTraced(),
+    ConcretizeTraced(),
+    JitInHotFunction(),
+    DictIterInTraced(),
+]
+
+__all__ = [
+    "RULES",
+    "ConcretizeTraced",
+    "DictIterInTraced",
+    "JitInHotFunction",
+    "PythonBranchOnTraced",
+]

@@ -1,0 +1,300 @@
+"""Declarative analysis targets: WHICH code each rule family watches.
+
+This file is the contract between the codebase's performance/concurrency
+architecture and the rule engine:
+
+  * the engine step loop's hot functions (pack -> dispatch -> fetch ->
+    decode/fan-out -> save) where per-message Python, device syncs and
+    unguarded telemetry are regressions (PR 1's columnar fan-out closed a
+    340x kernel-vs-e2e gap; these lists keep it closed);
+  * the jit-traced kernel code where Python control flow on traced values
+    and per-call trace-signature variance silently recompile;
+  * the declared LOCK HIERARCHY of the host runtime and the shared state
+    each lock guards (the two PR 3 data races — snapshot index/data skew
+    and the logdb compaction-vs-append lost update — were both
+    "documented-shared-state written outside its lock" bugs).
+
+Paths are package-relative ("engine/vector.py"); functions are qualnames
+("VectorEngine._decode", nested defs as "make_step_fn.apply").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+VECTOR = "engine/vector.py"
+NODE = "engine/node.py"
+EXEC = "engine/execengine.py"
+TRANSPORT = "transport/transport.py"
+LOGDB = "storage/logdb.py"
+TRACE = "trace.py"
+MANAGED = "rsm/managed.py"
+KERNEL = "ops/kernel.py"
+STATE = "ops/state.py"
+
+FnKey = Tuple[str, str]  # (relpath, qualname)
+
+
+@dataclass
+class LockSpec:
+    """One declared lock: its rank in the acquisition hierarchy (SMALLER =
+    must be taken FIRST / outermost) and a one-line role description."""
+
+    cls: str  # owning class name
+    attr: str  # attribute name on instances of cls
+    rank: int
+    doc: str = ""
+
+
+@dataclass
+class Targets:
+    """The full target configuration handed to every rule."""
+
+    # ---- hot-path families (PR 1 columnar fan-out) -----------------------
+    hot_functions: Set[FnKey] = field(default_factory=set)
+    hot_lock_functions: Set[FnKey] = field(default_factory=set)
+    hot_telemetry_functions: Set[FnKey] = field(default_factory=set)
+    hot_trace_functions: Set[FnKey] = field(default_factory=set)
+
+    # ---- device-sync family ---------------------------------------------
+    # the ONE blessed device->host transfer seam on the step path
+    blessed_device_get: Set[FnKey] = field(default_factory=set)
+    # dotted prefixes that name device-resident values in hot functions
+    device_roots: Set[str] = field(default_factory=set)
+
+    # ---- recompilation-hazard family ------------------------------------
+    # modules whose top-level functions all run under jit (minus exempt)
+    traced_modules: Set[str] = field(default_factory=set)
+    traced_exempt: Set[str] = field(default_factory=set)  # root qualnames
+    traced_functions: Set[FnKey] = field(default_factory=set)  # extras
+    # parameter names that are static under jit everywhere they appear
+    static_param_names: Set[str] = field(default_factory=set)
+
+    # ---- lock-discipline family -----------------------------------------
+    locks: List[LockSpec] = field(default_factory=list)
+    # variable-name -> class hints for non-self lock expressions (sh._mu)
+    lock_var_hints: Dict[str, str] = field(default_factory=dict)
+    # relpath -> {class -> {field -> guarding lock attr}}
+    guarded_state: Dict[str, Dict[str, Dict[str, str]]] = field(
+        default_factory=dict
+    )
+    # method-name suffix asserting the caller already holds the lock
+    locked_suffix: str = "_locked"
+
+    # -- queries -----------------------------------------------------------
+    def is_hot(self, key: FnKey) -> bool:
+        return key in self.hot_functions
+
+    def is_hot_lock(self, key: FnKey) -> bool:
+        return key in self.hot_lock_functions or key in self.hot_functions
+
+    def is_traced(self, key: FnKey) -> bool:
+        relpath, qualname = key
+        if key in self.traced_functions:
+            return True
+        return (
+            relpath in self.traced_modules
+            and qualname.split(".")[0] not in self.traced_exempt
+        )
+
+    def lock_rank(self, cls: Optional[str], attr: str, module=None):
+        """Resolve (class, attr) -> LockSpec; subclass names resolve
+        through the module's base map when one is provided."""
+        for spec in self.locks:
+            if spec.attr != attr:
+                continue
+            if cls is None or spec.cls == cls:
+                return spec
+            if module is not None and module.is_subclass_of(cls, spec.cls):
+                return spec
+        return None
+
+    def all_function_targets(self):
+        """(relpath, qualname, why) for config-drift detection."""
+        for name in (
+            "hot_functions",
+            "hot_lock_functions",
+            "hot_telemetry_functions",
+            "hot_trace_functions",
+            "blessed_device_get",
+            "traced_functions",
+        ):
+            for relpath, qualname in sorted(getattr(self, name)):
+                yield relpath, qualname, name
+
+
+def _default_targets() -> Targets:
+    # the step hot path: every function here runs once per engine step on
+    # the loop thread (pack -> dispatch -> fetch -> decode/fan-out -> save)
+    hot = {
+        (VECTOR, "VectorEngine._run_once"),
+        (VECTOR, "VectorEngine._pack"),
+        (VECTOR, "VectorEngine._pack_wire"),
+        (VECTOR, "VectorEngine._stage_row"),
+        (VECTOR, "VectorEngine._flush_staged_rows"),
+        (VECTOR, "VectorEngine._fetch_output"),
+        (VECTOR, "VectorEngine._decode"),
+        (VECTOR, "VectorEngine._dispatch_sends"),
+        (VECTOR, "VectorEngine._save_updates"),
+        (VECTOR, "VectorEngine.try_local_deliver_many"),
+        (VECTOR, "gather_replicate_sends"),
+        (VECTOR, "gather_post_sends"),
+        (VECTOR, "gather_resp_sends"),
+        (VECTOR, "build_save_updates"),
+    }
+    # the transport send hot path: one lock/breaker-check per TARGET
+    # BATCH, never per message
+    hot_lock = {
+        (TRANSPORT, "Transport.send_many"),
+        (TRANSPORT, "_SendQueue.put_many"),
+    }
+    hot_telemetry = set(hot) | set(hot_lock) | {
+        (TRANSPORT, "_SendQueue._admit_locked"),
+    }
+    # request entry points that mint trace ids + the decode/send phases
+    # that propagate them: unsampled requests stay allocation/event-free
+    hot_trace = {
+        (NODE, "Node.propose"),
+        (NODE, "Node.propose_batch"),
+        (NODE, "Node.propose_batch_async"),
+        (NODE, "Node.apply_raft_update"),
+        (VECTOR, "gather_replicate_sends"),
+        (VECTOR, "gather_resp_sends"),
+        (VECTOR, "VectorEngine._pack_wire"),
+        (VECTOR, "VectorEngine._decode"),
+        (TRANSPORT, "Transport.send_many"),
+    }
+    # the declared lock hierarchy, outermost first. Acquisition must go
+    # DOWN this table; taking an equal-or-outer lock while holding an
+    # inner one is an ordering violation.
+    locks = [
+        LockSpec(
+            "ManagedStateMachine", "_mu", 10,
+            "SM serialization (exclusive()): update+applied-advance and "
+            "snapshot index+data each form one critical section (PR 3 "
+            "snapshot skew fix)",
+        ),
+        LockSpec(
+            "_Shard", "_wmu", 20,
+            "logdb shard writer lock: append vs compaction boundary-batch "
+            "rewrite (PR 3 lost-update fix)",
+        ),
+        LockSpec(
+            "_Shard", "_mu", 30,
+            "logdb shard cache lock (state/max-index/last-batch caches)",
+        ),
+        LockSpec(
+            "Transport", "_mu", 40,
+            "transport registry lock (queue/breaker maps)",
+        ),
+        LockSpec(
+            "Node", "_mu", 41,
+            "per-node protocol lock (step vs API surface); API paths take "
+            "it before marking the engine dirty",
+        ),
+        LockSpec(
+            "VectorEngine", "_lanes_mu", 42, "engine lane registry",
+        ),
+        LockSpec(
+            "VectorEngine", "_dirty_mu", 44,
+            "engine dirty-set / pending-tick state",
+        ),
+        LockSpec(
+            "VectorEngine", "_snap_status_mu", 44,
+            "engine snapshot-completion set",
+        ),
+        LockSpec(
+            "_SendQueue", "_cv", 50,
+            "send-queue condition (urgent/bulk deques + admission counters)",
+        ),
+        LockSpec(
+            "_Breaker", "_mu", 50, "circuit-breaker state",
+        ),
+        LockSpec(
+            "MmapRing", "_mu", 60,
+            "flight-ring slot seal (leaf: taken with no other lock held)",
+        ),
+    ]
+    guarded_state = {
+        TRANSPORT: {
+            "_SendQueue": {
+                "_urgent": "_cv",
+                "_bulk": "_cv",
+                "_closed": "_cv",
+                "evicted_bulk": "_cv",
+                "dropped_bulk": "_cv",
+                "dropped_urgent": "_cv",
+            },
+            "_Breaker": {
+                "_state": "_mu",
+                "_fails": "_mu",
+                "_nominal": "_mu",
+                "_cooldown": "_mu",
+                "_opened_at": "_mu",
+                "_probe_inflight": "_mu",
+                "opens": "_mu",
+                "probes": "_mu",
+                "probe_failures": "_mu",
+            },
+        },
+        LOGDB: {
+            "_Shard": {
+                "_state_cache": "_mu",
+                "_max_index_cache": "_mu",
+                "_batch_cache": "_mu",
+            },
+        },
+        TRACE: {
+            "MmapRing": {"_seq": "_mu", "_mm": "_mu"},
+        },
+        MANAGED: {
+            "ManagedStateMachine": {"_destroyed": "_mu"},
+        },
+        VECTOR: {
+            "VectorEngine": {
+                "_dirty": "_dirty_mu",
+                "_gc_set": "_dirty_mu",
+                "_pending_ticks": "_dirty_mu",
+                "_snap_status": "_snap_status_mu",
+                "_lanes": "_lanes_mu",
+            },
+        },
+    }
+    return Targets(
+        hot_functions=hot,
+        hot_lock_functions=hot_lock,
+        hot_telemetry_functions=hot_telemetry,
+        hot_trace_functions=hot_trace,
+        blessed_device_get={(VECTOR, "VectorEngine._fetch_output")},
+        device_roots={"self._state"},
+        traced_modules={KERNEL},
+        traced_exempt={"make_step_fn"},
+        traced_functions={(VECTOR, "_make_activate_fn.apply")},
+        static_param_names={"cfg", "donate"},
+        locks=locks,
+        lock_var_hints={
+            "node": "Node",
+            "sh": "_Shard",
+            "sq": "_SendQueue",
+            "breaker": "_Breaker",
+        },
+        guarded_state=guarded_state,
+    )
+
+
+DEFAULT_TARGETS = _default_targets()
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "FnKey",
+    "LockSpec",
+    "Targets",
+    "KERNEL",
+    "LOGDB",
+    "MANAGED",
+    "NODE",
+    "STATE",
+    "TRACE",
+    "TRANSPORT",
+    "VECTOR",
+]

@@ -255,6 +255,7 @@ class Peer:
             "term": r.term,
             "vote": r.vote,
             "commit": r.log.committed,
+            "last_index": r.log.last_index(),
         }
 
 

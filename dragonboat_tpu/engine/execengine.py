@@ -318,6 +318,40 @@ class ExecEngine:
         """Tick-fairness watchdog snapshot (see engine/fairness.py)."""
         return self.watchdog.stats()
 
+    def lane_stats(self) -> Dict[int, dict]:
+        """Per-group introspection, shape-compatible with
+        VectorEngine.lane_stats(): cluster_id -> {node_id, leader_id,
+        term, commit_gap, ticks_since_leader_change}. Feeds the same
+        engine_lane_* gauges (NodeHost._export_health_gauges) and the
+        bench JSON lane fold, so dashboards read identically whichever
+        engine a host runs. Derived from each group's protocol core under
+        its step lock — the scalar engine hosts few groups and the export
+        cadence is ~1/s, so the per-group lock round-trip is noise here
+        (the vector engine's zero-sync numpy mirrors exist because it
+        hosts thousands)."""
+        out: Dict[int, dict] = {}
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            if node.stopped or not node.initialized.is_set():
+                continue
+            try:
+                st = node.local_status()
+            except Exception:
+                continue  # racing a concurrent close
+            tick = node.clock.tick
+            last = st.get("last_index", st["commit"])
+            out[node.cluster_id] = {
+                "node_id": st["node_id"],
+                "leader_id": st["leader_id"],
+                "term": st["term"],
+                "commit_gap": max(int(last - st["commit"]), 0),
+                "ticks_since_leader_change": max(
+                    int(tick - getattr(node, "_leader_change_tick", 0)), 0
+                ),
+            }
+        return out
+
     def stop(self) -> None:
         self.watchdog.close()
         self._stopped.set()

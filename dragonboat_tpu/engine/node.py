@@ -121,6 +121,10 @@ class Node:
         self._cc_queue: List = []
         self._leader_id = 0
         self._current_term = 0
+        # logical-clock stamp of the last observed leader transition:
+        # ExecEngine.lane_stats() derives ticks_since_leader_change from it
+        # (parity with the vector engine's _m_leader_change_tick mirror)
+        self._leader_change_tick = 0
         self._rate_limited = False  # refreshed each step (cf. node.go:1095)
         self._confirmed_applied = 0  # applied index confirmed into an Update
         self.initialized = threading.Event()
@@ -909,6 +913,8 @@ class Node:
 
         class _Adapter:
             def leader_updated(self, cluster_id, node_id, leader_id, term):
+                if leader_id != node._leader_id:
+                    node._leader_change_tick = node.clock.tick
                 node._leader_id = leader_id
                 node._current_term = term
                 if node.events is not None:

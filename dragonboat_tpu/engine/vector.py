@@ -1330,6 +1330,8 @@ class VectorEngine:
         with self._snap_status_mu:
             snap_done, self._snap_status = self._snap_status, set()
         for node in snap_done:
+            # lint: allow(locks/lock-in-hot-loop) snapshot completions:
+            # empty ~every step, bounded by in-flight snapshot workers
             with node._mu:
                 node._process_snapshot_status()
         with self._dirty_mu:
@@ -1558,6 +1560,9 @@ class VectorEngine:
             if node.incoming_reads.has_pending():
                 lane.staged_reads.extend(node.incoming_reads.get())
             if node._cc_queue:
+                # lint: allow(locks/lock-in-hot-loop) config changes: the
+                # lock-free emptiness probe above keeps steady-state lanes
+                # off this lock; only lanes with a queued cc pay it
                 with node._mu:
                     ccs, node._cc_queue = node._cc_queue, []
                 for cc, key in ccs:

@@ -1,0 +1,117 @@
+"""`python -m dragonboat_tpu.tools.check` — run the static analyzers.
+
+Runs every registered rule family (see `dragonboat_tpu.analysis`) over
+the package source (or explicit paths), prints findings, and exits
+non-zero when any UNSUPPRESSED finding remains — the tier-1 gate
+(tests/test_static_analysis.py) is exactly this call.
+
+    python -m dragonboat_tpu.tools.check                 # whole package
+    python -m dragonboat_tpu.tools.check engine/vector.py
+    python -m dragonboat_tpu.tools.check --json          # machine output
+    python -m dragonboat_tpu.tools.check --list-rules    # the rule table
+    python -m dragonboat_tpu.tools.check --family locks  # one family
+
+Suppressed findings are counted and visible with --show-suppressed (and
+always present in --json with "suppressed": true); a suppression without
+a reason is itself a finding.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..analysis import (
+    ALL_RULES,
+    FAMILIES,
+    build_analyzer,
+    unsuppressed,
+)
+
+
+def _list_rules() -> str:
+    lines = []
+    fam = None
+    for r in ALL_RULES:
+        f = r.id.split("/", 1)[0]
+        if f != fam:
+            fam = f
+            lines.append(f"[{fam}]")
+        lines.append(f"  {r.id}")
+        lines.append(f"      catches: {r.doc}")
+        lines.append(f"      why:     {r.motivation}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dragonboat_tpu.tools.check",
+        description="static analysis over the dragonboat_tpu source tree",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories (default: the dragonboat_tpu package)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument(
+        "--family",
+        action="append",
+        choices=FAMILIES,
+        help="restrict to a rule family (repeatable)",
+    )
+    ap.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print pragma-suppressed findings",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    ap.add_argument(
+        "--root",
+        default="",
+        help="package root for target matching (default: the installed "
+        "dragonboat_tpu directory) — point it at a checkout/overlay to "
+        "lint out-of-tree files against the same targets",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    analyzer = build_analyzer(families=args.family, root=args.root)
+    findings = analyzer.run(args.paths or None)
+    failing = unsuppressed(findings)
+    n_suppressed = len(findings) - len(failing)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "unsuppressed": len(failing),
+                    "suppressed": n_suppressed,
+                    "ok": not failing,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        return 1 if failing else 0
+
+    shown = findings if args.show_suppressed else failing
+    for f in shown:
+        print(f.render())
+    tail = (
+        f"{len(failing)} finding(s), {n_suppressed} suppressed"
+        if findings
+        else "clean"
+    )
+    print(f"dragonboat_tpu.tools.check: {tail}")
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

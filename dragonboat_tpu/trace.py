@@ -310,6 +310,10 @@ class MmapRing:
 
     def flush(self) -> None:
         try:
+            # lint: allow(locks/guarded-state) signal-safe: SIGTERM/atexit
+            # may fire while a writer holds _mu — taking it here could
+            # deadlock the dying process; a racing flush is an idempotent
+            # kernel page sync
             self._mm.flush()
         except (ValueError, OSError):
             pass

@@ -28,6 +28,14 @@ def pytest_configure(config):
         "select the matrix alone with `-m chaos` (seeds print on failure "
         "so any run replays from the CI log)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lint: the static-analysis gate (dragonboat_tpu.analysis over the "
+        "whole package + per-rule meta-tests) — the pure-AST, jax-free "
+        "slice of tier-1; run it alone with `-m lint` for a sub-second "
+        "pre-commit check (same gate as `python -m "
+        "dragonboat_tpu.tools.check`)",
+    )
 
 
 # ---- hang diagnosis (the Python half of the race-detection story; see

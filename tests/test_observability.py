@@ -402,6 +402,75 @@ def test_e2e_latency_histograms_and_step_stats(single_host):
         assert keys == sorted(keys)
 
 
+def test_scalar_engine_lane_stats_parity(tmp_path):
+    """ROADMAP PR-4 headroom item: ExecEngine.lane_stats() returns the
+    same per-lane shape as VectorEngine.lane_stats(), so engine_lane_*
+    gauges and the bench JSON lane fold cover the scalar engine too."""
+    import bench
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+    from tests.test_nodehost import KVSM
+
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=1,
+            rtt_millisecond=5,
+            raft_address="scl1:1",
+            raft_rpc_factory=lambda l: loopback_factory(l, reg),
+            enable_metrics=True,
+            engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "scl1:1"},
+            False,
+            lambda c, n: KVSM(c, n),
+            Config(cluster_id=1, node_id=1, election_rtt=10, heartbeat_rtt=2),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            lid, ok = nh.get_leader_id(1)
+            if ok and lid == 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("no leader")
+        sess = nh.get_noop_session(1)
+        for i in range(4):
+            nh.sync_propose(sess, f"k{i}=v".encode(), timeout_s=10.0)
+        stats = nh.engine.lane_stats()
+        assert 1 in stats, stats
+        s = stats[1]
+        # exact key parity with VectorEngine.lane_stats lanes
+        assert set(s) == {
+            "node_id",
+            "leader_id",
+            "term",
+            "commit_gap",
+            "ticks_since_leader_change",
+        }
+        assert s["node_id"] == 1
+        assert s["leader_id"] == 1
+        assert s["term"] >= 1
+        assert s["commit_gap"] >= 0
+        # the election happened after tick 0, and ticks advanced since
+        assert s["ticks_since_leader_change"] >= 0
+        # gauges flow through the same _export_health_gauges seam
+        nh._export_health_gauges()
+        assert nh.metrics.gauge_value("engine_lane_leader_id", (1, 1)) == 1.0
+        assert nh.metrics.gauge_value("engine_lane_term", (1, 1)) >= 1.0
+        # and the bench JSON lane fold works under the scalar engine
+        fold = bench._lane_report({1: nh})
+        assert fold["lanes_total"] == 1
+        assert fold["lanes_with_leader"] == 1
+        assert fold["lane_commit_gap_max"] >= 0
+    finally:
+        nh.stop()
+
+
 def test_e2e_unsampled_requests_stay_traceless(tmp_path):
     """profile_sample_ratio=0 -> sparse default (1/32): a couple of
     proposals should mostly carry NO trace object (allocation-free hot

@@ -1,0 +1,595 @@
+"""Tier-1 gate + meta-tests for `dragonboat_tpu.analysis`.
+
+Two halves:
+
+  * the GATE — the full analyzer over the real `dragonboat_tpu/` tree
+    must report zero unsuppressed findings (exactly what
+    `python -m dragonboat_tpu.tools.check` enforces, and the CLI itself
+    is exercised via subprocess);
+  * the META-TESTS — one known-bad snippet per rule family, asserting
+    the engine reports exactly the seeded violations (a broken linter
+    silently passing everything is worse than no linter — the
+    `test_*_catches_regressions` pattern from the legacy embedded lint).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dragonboat_tpu.analysis import (
+    ALL_RULES,
+    FAMILIES,
+    build_analyzer,
+    unsuppressed,
+)
+from dragonboat_tpu.analysis.engine import SourceModule
+from dragonboat_tpu.analysis.targets import DEFAULT_TARGETS, Targets
+
+pytestmark = pytest.mark.lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(snippet: str, relpath: str, families=None):
+    a = build_analyzer(families=families)
+    return a.run_module(SourceModule.from_snippet(snippet, relpath))
+
+
+def _ids(findings):
+    return sorted(f.rule for f in findings if not f.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    findings = build_analyzer().run()
+    bad = unsuppressed(findings)
+    assert not bad, "\n" + "\n".join(f.render() for f in bad)
+
+
+def test_every_rule_documents_itself():
+    for r in ALL_RULES:
+        assert r.id and "/" in r.id, r
+        assert r.doc, r.id
+        assert r.motivation, r.id
+    assert len({r.id for r in ALL_RULES}) == len(ALL_RULES)
+
+
+def test_cli_clean_tree_exits_zero():
+    p = subprocess.run(
+        [sys.executable, "-m", "dragonboat_tpu.tools.check"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_flags_seeded_bad_file_per_family(tmp_path):
+    """One known-bad snippet per family, written into a file the CLI is
+    pointed at under the relpath each family watches — non-zero exit and
+    the family's rule id in --json output (the acceptance criterion)."""
+    cases = {
+        "columnar": (
+            "engine/vector.py",
+            "class VectorEngine:\n"
+            "    def _decode(self, worked, packs, o):\n"
+            "        for g in gs:\n"
+            "            x = o['term'][g].item()\n",
+        ),
+        "device-sync": (
+            "engine/vector.py",
+            "class VectorEngine:\n"
+            "    def _decode(self, worked, packs, o):\n"
+            "        x = jax.device_get(self._state.term)\n",
+        ),
+        "retrace": (
+            "ops/kernel.py",
+            "def step_batch(s, inbox, ticks, cfg):\n"
+            "    if s.term > 0:\n"
+            "        return s\n",
+        ),
+        "locks": (
+            "transport/transport.py",
+            "class _SendQueue:\n"
+            "    def put_many(self, msgs):\n"
+            "        for m in msgs:\n"
+            "            with self._cv:\n"
+            "                pass\n",
+        ),
+        "telemetry": (
+            "transport/transport.py",
+            "class Transport:\n"
+            "    def send_many(self, msgs):\n"
+            "        self.metrics.observe('x', (0, 0), 1.0)\n"
+            "        flight_recorder().record('evt')\n",
+        ),
+        "trace": (
+            "engine/node.py",
+            "class Node:\n"
+            "    def propose(self, session, cmd, timeout_ticks):\n"
+            "        entry.trace_id = mint_trace_id()\n",
+        ),
+    }
+    for family, (relpath, src) in cases.items():
+        root = tmp_path / family
+        target = root / relpath
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(src)
+        p = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "dragonboat_tpu.tools.check",
+                "--json",
+                "--root",
+                str(root),
+                str(root),
+            ],
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert p.returncode == 1, (family, p.stdout, p.stderr)
+        out = json.loads(p.stdout)
+        fams = {f["rule"].split("/")[0] for f in out["findings"]}
+        assert family in fams, (family, out)
+
+
+def test_cli_list_rules_renders_table():
+    p = subprocess.run(
+        [sys.executable, "-m", "dragonboat_tpu.tools.check", "--list-rules"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert p.returncode == 0
+    for r in ALL_RULES:
+        assert r.id in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: columnar family
+# ---------------------------------------------------------------------------
+
+
+def test_columnar_catches_regressions():
+    got = _run(
+        """
+        def gather_post_sends(o, gs):
+            for g in gs.tolist():
+                x = int(o['term'][g])
+                y = o['match'][g].tolist()
+                z = o['vote'][g].item()
+        """,
+        "engine/vector.py",
+        families=("columnar",),
+    )
+    # iterator .tolist() is the allowed fast idiom; the three loop-body
+    # reads are the banned per-element patterns
+    assert _ids(got) == [
+        "columnar/item-in-loop",
+        "columnar/item-in-loop",
+        "columnar/scalar-index-in-loop",
+    ], got
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: device-sync family
+# ---------------------------------------------------------------------------
+
+
+def test_device_sync_catches_regressions():
+    got = _run(
+        """
+        class VectorEngine:
+            def _decode(self, worked, packs, o):
+                a = jax.device_get(self._state.term)
+                self._state.match.block_until_ready()
+                b = int(self._state.last_index[3])
+                c = np.asarray(self._state.commit)
+                for g in gs:
+                    d = self._state.term[g]
+        """,
+        "engine/vector.py",
+        families=("device-sync",),
+    )
+    assert _ids(got) == [
+        "device-sync/device-get",
+        "device-sync/device-get",
+        "device-sync/host-array",
+        "device-sync/index-in-loop",
+        "device-sync/scalar-read",
+    ], got
+
+
+def test_device_sync_blessed_seam_stays_allowed():
+    got = _run(
+        """
+        class VectorEngine:
+            def _fetch_output(self, out):
+                return jax.device_get(out)._asdict()
+        """,
+        "engine/vector.py",
+        families=("device-sync",),
+    )
+    assert not _ids(got), got
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: retrace family
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_catches_regressions():
+    got = _run(
+        """
+        def step_batch(s, inbox, ticks, cfg):
+            if s.term.sum() > 0:
+                x = 1
+            derived = s.last_index + 1
+            while derived > 0:
+                pass
+            n = int(s.committed)
+            m = np.asarray(ticks)
+            for k, v in inbox.items():
+                pass
+        """,
+        "ops/kernel.py",
+        families=("retrace",),
+    )
+    assert _ids(got) == [
+        "retrace/concretize-traced",
+        "retrace/concretize-traced",
+        "retrace/dict-iter-in-traced",
+        "retrace/python-branch-on-traced",
+        "retrace/python-branch-on-traced",
+        "retrace/python-branch-on-traced",
+    ], got
+
+
+def test_retrace_static_escapes_stay_allowed():
+    # shape/dtype/len are Python values at trace time; branching on them
+    # is how shape-specialized kernels are written. `cfg` is static, and
+    # identity comparison never reads a traced value.
+    got = _run(
+        """
+        def step_batch(s, inbox, ticks, cfg):
+            W = s.log_term.shape[1]
+            if W > 8:
+                x = 1
+            if cfg.peers > 2:
+                y = 2
+            if len(ticks) > 4:
+                z = 3
+            for i in range(cfg.peers):
+                pass
+            def sel(n, o):
+                if n is o:
+                    return o
+        """,
+        "ops/kernel.py",
+        families=("retrace",),
+    )
+    assert not _ids(got), got
+
+
+def test_retrace_taint_flows_out_of_nested_blocks():
+    """Fixpoint propagation: an assignment inside a loop body taints
+    later top-level uses (ast.walk order is not source order — a single
+    pass missed this)."""
+    got = _run(
+        """
+        def step_batch(s, inbox, ticks, cfg):
+            for i in range(3):
+                y = s.term + i
+            z = y
+            if z > 0:
+                pass
+        """,
+        "ops/kernel.py",
+        families=("retrace",),
+    )
+    assert _ids(got) == ["retrace/python-branch-on-traced"], got
+
+
+def test_retrace_jit_in_hot_function():
+    got = _run(
+        """
+        class VectorEngine:
+            def _run_once(self):
+                f = jax.jit(lambda s: s)
+                g = make_step_fn(self.kcfg)
+        """,
+        "engine/vector.py",
+        families=("retrace",),
+    )
+    assert _ids(got) == ["retrace/jit-in-hot", "retrace/jit-in-hot"], got
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: locks family
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_catches_inversion():
+    got = _run(
+        """
+        class _Shard:
+            def save(self, ud):
+                with self._mu:
+                    with self._wmu:
+                        pass
+            def ok(self, ud):
+                with self._wmu:
+                    with self._mu:
+                        pass
+        """,
+        "storage/logdb.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/order"], got
+
+
+def test_lock_order_catches_two_instance_inversion():
+    """self._mu then other._mu on another instance of the SAME class is
+    the classic AB/BA deadlock (undefined instance order) and must flag
+    even though both resolve to one LockSpec."""
+    got = _run(
+        """
+        class Node:
+            def transfer(self, node):
+                with self._mu:
+                    with node._mu:
+                        pass
+        """,
+        "engine/node.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/order"], got
+    assert "two instances" in got[0].message
+
+
+def test_guarded_state_catches_unlocked_writes():
+    got = _run(
+        """
+        class _SendQueue:
+            def poke(self, m):
+                self._bulk.append(m)
+                self._closed = True
+                with self._cv:
+                    self._urgent.append(m)
+            def _admit_locked(self, m):
+                self._bulk.append(m)
+        """,
+        "transport/transport.py",
+        families=("locks",),
+    )
+    # the two unlocked writes in poke(); the with-guarded append and the
+    # *_locked-suffix method are both allowed
+    assert _ids(got) == [
+        "locks/guarded-state",
+        "locks/guarded-state",
+    ], got
+
+
+def test_guarded_state_nested_def_does_not_inherit_lock():
+    got = _run(
+        """
+        class _SendQueue:
+            def poke(self, m):
+                with self._cv:
+                    def later():
+                        self._bulk.append(m)
+        """,
+        "transport/transport.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/guarded-state"], got
+
+
+def test_lock_in_hot_loop_catches_regressions():
+    got = _run(
+        """
+        class _SendQueue:
+            def put_many(self, msgs):
+                n = 0
+                for m in msgs:
+                    with self._cv:
+                        n += 1
+                with self._cv:
+                    pass
+                return n
+        """,
+        "transport/transport.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/lock-in-hot-loop"], got
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: telemetry + trace families
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_catches_regressions():
+    got = _run(
+        """
+        class Transport:
+            def send_many(self, msgs):
+                for m in msgs:
+                    self.metrics.observe('x', (0, 0), 1.0)
+                recorder.record('evt', a=1)
+                if self.profiler.sampling:
+                    self.metrics.observe('x', (0, 0), 1.0)
+                if lat_sampler.sample():
+                    recorder.record('evt')
+        """,
+        "transport/transport.py",
+        families=("telemetry",),
+    )
+    assert _ids(got) == [
+        "telemetry/unguarded",
+        "telemetry/unguarded",
+    ], got
+
+
+def test_trace_stamp_catches_regressions():
+    got = _run(
+        """
+        class Node:
+            def propose(self, session, cmd, timeout_ticks):
+                entry.trace_id = mint_trace_id()
+                recorder.record('propose_enqueue', trace=entry.trace_id)
+                if self._req_sampler.sample():
+                    entry.trace_id = mint_trace_id()
+                    recorder.record('propose_enqueue')
+                if entry.trace_id:
+                    recorder.record('replicate_send')
+        """,
+        "engine/node.py",
+        families=("trace",),
+    )
+    # unguarded: the stamp, the mint inside it, and the record
+    assert _ids(got) == [
+        "trace/unguarded-stamp",
+        "trace/unguarded-stamp",
+        "trace/unguarded-stamp",
+    ], got
+
+
+# ---------------------------------------------------------------------------
+# suppression pragmas
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason():
+    got = _run(
+        """
+        class VectorEngine:
+            def _decode(self, worked, packs, o):
+                for g in gs:
+                    x = o['t'][g].item()  # lint: allow(columnar/item-in-loop) rare lane, bounded
+        """,
+        "engine/vector.py",
+        families=("columnar",),
+    )
+    assert not _ids(got)
+    assert len(got) == 1 and got[0].suppressed
+    assert "rare lane" in got[0].suppress_reason
+
+
+def test_standalone_pragma_covers_next_code_line_with_continuation():
+    got = _run(
+        """
+        class VectorEngine:
+            def _decode(self, worked, packs, o):
+                for g in gs:
+                    # lint: allow(columnar) quiesce exit is bounded by the
+                    # number of wake events, not messages
+                    x = o['t'][g].item()
+        """,
+        "engine/vector.py",
+        families=("columnar",),
+    )
+    assert not _ids(got)
+    assert len(got) == 1 and got[0].suppressed
+    assert "wake events" in got[0].suppress_reason
+
+
+def test_pragma_without_reason_is_itself_a_finding():
+    got = _run(
+        """
+        class VectorEngine:
+            def _decode(self, worked, packs, o):
+                for g in gs:
+                    x = o['t'][g].item()  # lint: allow(columnar/item-in-loop)
+        """,
+        "engine/vector.py",
+        families=("columnar",),
+    )
+    assert _ids(got) == ["pragma/missing-reason"], got
+
+
+def test_legacy_hot_path_mark_still_suppresses():
+    got = _run(
+        """
+        class Transport:
+            def send_many(self, msgs):
+                recorder.record('evt')  # hot-path: ok (anomaly-only)
+        """,
+        "transport/transport.py",
+        families=("telemetry",),
+    )
+    assert not _ids(got)
+    assert len(got) == 1 and got[0].suppressed
+
+
+def test_unrelated_pragma_does_not_suppress():
+    got = _run(
+        """
+        class VectorEngine:
+            def _decode(self, worked, packs, o):
+                for g in gs:
+                    x = o['t'][g].item()  # lint: allow(locks) wrong family
+        """,
+        "engine/vector.py",
+        families=("columnar",),
+    )
+    assert _ids(got) == ["columnar/item-in-loop"], got
+
+
+# ---------------------------------------------------------------------------
+# config drift
+# ---------------------------------------------------------------------------
+
+
+def test_missing_target_is_reported(tmp_path):
+    """A watched hot function disappearing must surface as a finding, not
+    as a silently-unenforced rule (the legacy lint failed the same way)."""
+    pkg = tmp_path / "pkg"
+    (pkg / "engine").mkdir(parents=True)
+    (pkg / "engine" / "vector.py").write_text(
+        "class VectorEngine:\n    def _renamed(self):\n        pass\n"
+    )
+    a = build_analyzer(root=str(pkg))
+    findings = a.run()
+    drift = [f for f in findings if f.rule == "config/missing-target"]
+    assert drift, findings
+    assert any("VectorEngine._decode" in f.message for f in drift)
+
+
+def test_nonexistent_path_fails_loudly():
+    """A typo'd path must NOT report a clean gate that checked nothing."""
+    findings = build_analyzer().run(["no/such/dir"])
+    assert [f.rule for f in findings] == ["config/no-such-path"], findings
+
+
+def test_relative_paths_resolve_against_package_root():
+    """`tools.check engine/` works from any cwd: paths missing from the
+    cwd are retried under the analyzer root."""
+    findings = build_analyzer().run(["engine"])
+    assert not [f for f in findings if f.rule == "config/no-such-path"]
+
+
+def test_families_cover_issue_contract():
+    """The PR contract: four migrated legacy families + three new
+    analyzer families, all registered."""
+    assert set(FAMILIES) >= {
+        "columnar",
+        "locks",
+        "telemetry",
+        "trace",
+        "device-sync",
+        "retrace",
+    }
