@@ -335,11 +335,20 @@ def _bench_e2e_body(
             time.sleep(0.05)
     bring_up_s = time.monotonic() - t0
     if pending:
-        return {"error": f"{len(pending)} groups never elected", "value": 0.0}
+        err = {"error": f"{len(pending)} groups never elected", "value": 0.0}
+        err.update(_attribution_report(hosts, None, None))
+        return err
     # warmup: the first kernel compile stalls every engine and piles ticks;
     # the resulting election churn settles within ~2s. Measuring through it
     # records churn losses, not steady-state throughput.
     time.sleep(2.0)
+    # runtime sync/retrace audit marks: the folds below report the
+    # MEASUREMENT WINDOW's deltas (bring-up legitimately compiles; a
+    # steady-state compile or stray sync is the regression signal)
+    from dragonboat_tpu.profile import compile_watch, sync_audit
+
+    sync_mark = sync_audit().snapshot()
+    compile_mark = compile_watch().install().snapshot()
     if snap_fn is not None:
         for c, (lid, _t) in snap_fn().items():
             if lid and c in leaders:
@@ -469,8 +478,57 @@ def _bench_e2e_body(
         out["membership_changes"] = churn_state["membership"]
     if host_stages:
         out.update(host_stages)
+    out.update(_attribution_report(hosts, sync_mark, compile_mark))
     out.update(_latency_report(hosts))
     out.update(_lane_report(hosts))
+    return out
+
+
+def _engine_profilers(hosts) -> dict:
+    """Every DISTINCT engine profiler across the hosts (a shared core
+    hands every host the same object — counted once; shared=False runs
+    sum the per-host engines)."""
+    profs = {}
+    for nh in hosts.values():
+        prof = getattr(getattr(nh, "engine", None), "profiler", None)
+        if prof is not None:
+            profs[id(prof)] = prof
+    return profs
+
+
+def _attribution_report(hosts, sync_mark, compile_mark) -> dict:
+    """The perf attribution fold (tools.perfdiff's input): an ALWAYS-
+    present `phase_breakdown` with every canonical phase key (zero when
+    the phase never ran, so the JSON schema is stable across configs and
+    the gate can diff any two runs), plus the measurement-window
+    `device_syncs` / `compile_events` deltas from the runtime audit.
+    `sync_mark`/`compile_mark` of None (the bring-up-failed path) report
+    zero-delta audits so the schema still holds."""
+    from dragonboat_tpu.profile import (
+        VECTOR_PHASES,
+        compile_watch,
+        diff_compiles,
+        diff_sync,
+        sync_audit,
+    )
+
+    phases = {p: 0.0 for p in VECTOR_PHASES}
+    for prof in _engine_profilers(hosts).values():
+        for name, s in prof.summary().items():
+            phases[name] = round(phases.get(name, 0.0) + s["total_s"], 4)
+    out = {"phase_breakdown": phases}
+    if sync_mark is None:
+        out["device_syncs"] = {"in_seam": 0, "out_of_seam": 0, "sites": {}}
+    else:
+        out["device_syncs"] = diff_sync(sync_mark, sync_audit().snapshot())
+    if compile_mark is None:
+        out["compile_events"] = {
+            "total": 0, "total_s": 0.0, "per_function": {},
+        }
+    else:
+        out["compile_events"] = diff_compiles(
+            compile_mark, compile_watch().snapshot()
+        )
     return out
 
 
@@ -538,8 +596,10 @@ def _latency_report(hosts) -> dict:
 
 
 # vector-engine profiler stages making up the host fan-out half of a step
-# (everything between the device fetch and the next pack)
-_FANOUT_STAGES = ("place", "send_rep", "send_resp", "apply")
+# (everything between the device fetch and the next pack; "deliver" is a
+# sub-span nested inside the send/apply/reads phases, so it is excluded
+# here to avoid double counting)
+_FANOUT_STAGES = ("place", "send_rep", "send_resp", "apply", "reads")
 
 
 def _host_stage_report(hosts) -> dict:
@@ -547,14 +607,7 @@ def _host_stage_report(hosts) -> dict:
     seconds per stage (pack / device dispatch+step / fan-out / save) plus
     the fan-out+pack share of step wall time — the number the columnar
     host dataflow is accountable to."""
-    # aggregate over every DISTINCT engine profiler: shared cores hand all
-    # hosts the same object (counted once); shared=False runs sum the
-    # per-host engines so the totals cover the whole run's host work
-    profs = {}
-    for nh in hosts.values():
-        prof = getattr(nh.engine, "profiler", None)
-        if prof is not None:
-            profs[id(prof)] = prof
+    profs = _engine_profilers(hosts)
     totals_raw: dict = {}
     for prof in profs.values():
         for name, s in prof.summary().items():
@@ -562,7 +615,9 @@ def _host_stage_report(hosts) -> dict:
     if not totals_raw:
         return {}
     totals = {name: round(v, 4) for name, v in totals_raw.items()}
-    wall = sum(totals_raw.values())
+    # "deliver" is a sub-span of the send/apply/reads phases: keep it out
+    # of the wall sum or its seconds would count twice
+    wall = sum(v for n, v in totals_raw.items() if n != "deliver")
     fanout = sum(totals_raw.get(n, 0.0) for n in _FANOUT_STAGES)
     pack = totals_raw.get("pack", 0.0)
     out = {"host_stage_total_s": totals}
@@ -683,9 +738,14 @@ def _run_ladder_config(
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     r["label"] = spec["label"]
+    # bench honesty: the JSON names BOTH the regime the ladder config
+    # claims (nominal_groups) and what this run actually exercised
+    # (actual_groups); a run standing in for a larger regime is stamped
+    # scaled_down so tools.perfdiff refuses to compare it against a
+    # nominal run of the same config
     r["nominal_groups"] = spec["nominal_groups"]
-    if groups != spec["nominal_groups"]:
-        r["scaled_down"] = True
+    r["actual_groups"] = groups
+    r["scaled_down"] = groups != spec["nominal_groups"]
     return r
 
 
@@ -722,6 +782,15 @@ def main() -> None:
     # warm XLA compiles across bench runs (each ladder config's engine
     # shape costs seconds of compile; the cache makes reruns start warm)
     enable_compile_cache()
+    # runtime perf attribution: count XLA compile events and wrap
+    # jax.device_get/block_until_ready so any transfer outside the
+    # blessed _fetch_output seam lands in the device_syncs fold with its
+    # call site (dragonboat_tpu.profile; the runtime twin of `-m lint`'s
+    # device-sync/retrace families)
+    from dragonboat_tpu.profile import compile_watch, sync_audit
+
+    compile_watch().install()
+    sync_audit().install()
 
     RECORD["platform"] = platform
     if platform == "cpu-fallback":

@@ -28,6 +28,7 @@ EXEC = "engine/execengine.py"
 TRANSPORT = "transport/transport.py"
 LOGDB = "storage/logdb.py"
 TRACE = "trace.py"
+PROFILE = "profile.py"
 MANAGED = "rsm/managed.py"
 KERNEL = "ops/kernel.py"
 STATE = "ops/state.py"
@@ -150,6 +151,13 @@ def _default_targets() -> Targets:
     }
     hot_telemetry = set(hot) | set(hot_lock) | {
         (TRANSPORT, "_SendQueue._admit_locked"),
+        # the step-phase profiler's stamping seams (PR 6 attribution
+        # plane): Sample.record + the phase-plane fan-out run once per
+        # stage per step — they must stay inside the `if self.sampling`
+        # gate or every step pays histogram/recorder work
+        (TRACE, "Profiler.end"),
+        (TRACE, "Profiler.add"),
+        (PROFILE, "PhasePlane.on_phase"),
     }
     # request entry points that mint trace ids + the decode/send phases
     # that propagate them: unsampled requests stay allocation/event-free
@@ -214,6 +222,19 @@ def _default_targets() -> Targets:
             "MmapRing", "_mu", 60,
             "flight-ring slot seal (leaf: taken with no other lock held)",
         ),
+        LockSpec(
+            "PhasePlane", "_mu", 60,
+            "phase-histogram table (leaf: dict probe only; the Histogram "
+            "observation itself happens outside it)",
+        ),
+        LockSpec(
+            "SyncAudit", "_mu", 60,
+            "device-sync site-attribution table (leaf)",
+        ),
+        LockSpec(
+            "CompileWatch", "_mu", 60,
+            "compile-event counters + registered-function table (leaf)",
+        ),
     ]
     guarded_state = {
         TRANSPORT: {
@@ -246,6 +267,11 @@ def _default_targets() -> Targets:
         },
         TRACE: {
             "MmapRing": {"_seq": "_mu", "_mm": "_mu"},
+        },
+        PROFILE: {
+            "PhasePlane": {"_hists": "_mu"},
+            "SyncAudit": {"_out": "_mu"},
+            "CompileWatch": {"_fns": "_mu"},
         },
         MANAGED: {
             "ManagedStateMachine": {"_destroyed": "_mu"},
@@ -293,6 +319,7 @@ __all__ = [
     "LOGDB",
     "MANAGED",
     "NODE",
+    "PROFILE",
     "STATE",
     "TRACE",
     "TRANSPORT",

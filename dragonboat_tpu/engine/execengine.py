@@ -18,6 +18,7 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..logger import get_logger
+from ..profile import phase_plane
 from ..settings import hard, soft
 from ..trace import LatencySampler, Profiler
 from ..types import Update
@@ -142,6 +143,11 @@ class ExecEngine:
         self.profilers = (
             [Profiler(ratio) for _ in range(self._n_step)] if ratio > 0 else []
         )
+        # sampled stage durations fan out to the shared phase plane
+        # (engine_phase_seconds{engine="exec",phase=...}) so scalar and
+        # vector step attribution read on one scale
+        for p in self.profilers:
+            p.attach_phase_plane(phase_plane(), "exec")
         # request-lifecycle latency sampling (see trace.LatencySampler):
         # same contract as the vector engine — a disabled stage profiler
         # still leaves the sparse 1-in-32 request sampler on, so latency

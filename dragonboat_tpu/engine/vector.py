@@ -100,6 +100,7 @@ from ..ops.state import (
     lane_seed,
     rebase,
 )
+from ..profile import compile_watch, note_seam_sync, phase_plane
 from ..requests import LogicalClock
 from ..settings import soft
 from ..storage.kv import sync_all as _kv_sync_all
@@ -223,7 +224,9 @@ def _make_activate_fn(cfg: KernelConfig, n: int):
             ri_count=s.ri_count.at[gi].set(zi),
         )
 
-    return jax.jit(apply, donate_argnums=(0,))
+    return compile_watch().register(
+        f"activate[n{n}]", jax.jit(apply, donate_argnums=(0,))
+    )
 
 
 class _SharedClock(LogicalClock):
@@ -924,6 +927,10 @@ class VectorEngine:
         # EngineConfig.profile_sample_ratio=1.
         ratio = (getattr(ecfg, "profile_sample_ratio", 0) or 0) if ecfg else 0
         self.profiler = Profiler(sample_ratio=ratio if ratio > 0 else 32)
+        # sampled stage durations also land in the process-global phase
+        # plane (engine_phase_seconds{engine="vector",phase=...} + flight-
+        # recorder spans); unsampled steps never reach it
+        self.profiler.attach_phase_plane(phase_plane(), "vector")
         # request-lifecycle latency sampling shares the profiler's ratio
         # knob: 1-in-N proposals/reads carry a LatencyTrace into the
         # proposal_commit/apply and readindex latency histograms; the
@@ -972,6 +979,12 @@ class VectorEngine:
         )
         self._last_tick_burst = 0
         self._step_fn = make_step_fn(self.kcfg, donate=True)
+        # runtime retrace attribution: the step kernel's trace cache is
+        # watched per function; a steady-state compile shows up in
+        # engine_compile_events_total and fails the perf tier-1 assertion
+        compile_watch().install().register(
+            f"step_batch[g{self.kcfg.groups}]", self._step_fn
+        )
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
             self._state = jax.tree.map(
@@ -1447,7 +1460,8 @@ class VectorEngine:
         prof = self.profiler
         prof.start()
         o = jax.device_get(out)._asdict()
-        prof.end("step")
+        note_seam_sync()  # runtime sync audit: the ONE blessed transfer
+        prof.end("fetch")
         return o
 
     def _flush_pending(self) -> None:
@@ -2176,7 +2190,9 @@ class VectorEngine:
                 self.set_task_ready(lane.key)
             st["entries_applied"] += applied_n
             st["lanes_commit_advanced"] += lanes_n
+        prof.end("apply")
         # ---- phase 5: confirmed reads ------------------------------------
+        prof.start()
         rc = o["ready_count"]
         ready_gs = np.nonzero(rc)[0]
         if ready_gs.size:
@@ -2232,7 +2248,7 @@ class VectorEngine:
                 lane.node.pending_read_indexes.applied(
                     lane.node.sm.last_applied_index()
                 )
-        prof.end("apply")
+        prof.end("reads")
         # ---- phase 6: maintenance ----------------------------------------
         prof.start()
         self._maintain(o)
@@ -2245,6 +2261,11 @@ class VectorEngine:
         order within the batch is preserved per destination."""
         if not sends:
             return
+        # "deliver" sub-span: the bulk send/deliver seam's share of the
+        # enclosing send/apply/reads phase (sampled iterations only — the
+        # off path pays no clock reads)
+        prof = self.profiler
+        t0 = time.monotonic() if prof.sampling else 0.0
         by_node: Dict[object, List[Message]] = {}
         for lane, m in sends:
             node = lane.node
@@ -2260,6 +2281,8 @@ class VectorEngine:
                 send = node._send_message
                 for m in msgs:
                     send(m)
+        if prof.sampling:
+            prof.add("deliver", time.monotonic() - t0)
 
     def _save_updates(self, updates: List[Update], lane_saves) -> None:
         """One multi-group write wave per step: a single write-batch per

@@ -103,6 +103,25 @@ def _labels(pairs) -> str:
     return "{" + ",".join(f'{k}="{v}"' for k, v in sorted(pairs)) + "}"
 
 
+def write_histogram_series(w, full: str, label_pairs, h: "Histogram") -> None:
+    """One labelled histogram series in Prometheus text format: cumulative
+    `_bucket{le=...}` lines, a `+Inf` bucket equal to `_count`, then
+    `_sum`/`_count`. Shared by MetricsRegistry.write and the perf
+    attribution plane (profile.PhasePlane), so both expositions obey the
+    same conformance contract (tests/test_observability.py parser)."""
+    counts, total_sum, count = h.snapshot()
+    base = tuple(label_pairs)
+    cum = 0
+    for bound, c in zip(h.bounds, counts):
+        cum += c
+        w.write(
+            f"{full}_bucket{_labels(base + (('le', f'{bound:g}'),))} {cum}\n"
+        )
+    w.write(f"{full}_bucket{_labels(base + (('le', '+Inf'),))} {count}\n")
+    w.write(f"{full}_sum{_labels(base)} {total_sum:g}\n")
+    w.write(f"{full}_count{_labels(base)} {count}\n")
+
+
 class MetricsRegistry:
     """Counter/gauge/histogram registry with Prometheus text exposition."""
 
@@ -172,22 +191,9 @@ class MetricsRegistry:
                 full = f"{self._prefix}_{name}"
                 w.write(f"# TYPE {full} histogram\n")
                 for (cid, nid), h in sorted(self._hists[name].items()):
-                    counts, total_sum, count = h.snapshot()
-                    base = (("clusterid", cid), ("nodeid", nid))
-                    cum = 0
-                    for bound, c in zip(h.bounds, counts):
-                        cum += c
-                        w.write(
-                            f"{full}_bucket"
-                            f"{_labels(base + (('le', f'{bound:g}'),))}"
-                            f" {cum}\n"
-                        )
-                    w.write(
-                        f"{full}_bucket"
-                        f"{_labels(base + (('le', '+Inf'),))} {count}\n"
+                    write_histogram_series(
+                        w, full, (("clusterid", cid), ("nodeid", nid)), h
                     )
-                    w.write(f"{full}_sum{_labels(base)} {total_sum:g}\n")
-                    w.write(f"{full}_count{_labels(base)} {count}\n")
 
 
 class RaftEventAggregator:
@@ -355,4 +361,5 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "RaftEventAggregator",
+    "write_histogram_series",
 ]

@@ -36,6 +36,8 @@ from .requests import (
 from .rsm import SSRequest, SS_REQ_EXPORTED, SS_REQ_USER
 from .statemachine import Result, sm_type_of
 from .storage import LogReader, ShardedLogDB
+from .profile import compile_watch, sync_audit
+from .profile import write_exposition as _write_profile_exposition
 from .trace import flight_recorder, read_mmap_ring
 from .transport import Transport, loopback_factory
 from .transport.tcp import tcp_factory
@@ -279,20 +281,78 @@ class NodeHost(IMessageHandler):
             full = f"dragonboat_tpu_transport_{name}_total"
             w.write(f"# TYPE {full} counter\n")
             w.write(f"{full} {v:g}\n")
+        # perf attribution plane: engine_phase_seconds{engine=,phase=}
+        # histograms + per-jitted-function compile-cache gauges
+        _write_profile_exposition(w)
 
     # ----------------------------------------------------------- forensics
-    def dump_flight(self, path: str, cluster_id: Optional[int] = None) -> str:
+    # dump_flight artifact bound: a runaway event source must not turn a
+    # forensic dump into a disk-filling liability on a production host
+    # (the ROADMAP "ship recorder dumps off-host" headroom's shippable
+    # slice — bounded, compressed artifacts)
+    DUMP_FLIGHT_MAX_BYTES = 8 << 20
+
+    def dump_flight(
+        self,
+        path: str,
+        cluster_id: Optional[int] = None,
+        max_bytes: int = DUMP_FLIGHT_MAX_BYTES,
+    ) -> str:
         """Write the process flight recorder as JSONL (optionally filtered
         to one cluster) with a `_meta` header line so tools.timeline can
-        merge this host's dump with other hosts' on one clock. Returns
-        the path."""
+        merge this host's dump with other hosts' on one clock.
+
+        Artifact discipline: the dump is capped at `max_bytes` — when the
+        serialized timeline exceeds it, the OLDEST lines are dropped (the
+        recent tail is the forensic payload) and the `_meta` line carries
+        `dropped_events`. A pre-existing artifact at `path` rotates to
+        `<path>.1.gz` (gzip-compressed, previous rotation overwritten) so
+        repeated dumps keep exactly one bounded predecessor. A `path`
+        ending in `.gz` writes gzip directly; tools.timeline reads both
+        transparently. Returns the path."""
+        import gzip
+
         rec = flight_recorder()
         kw = {} if cluster_id is None else {"cluster_id": cluster_id}
-        with open(path, "w") as f:
-            f.write(
-                rec.to_jsonl(meta={"source": self.config.raft_address}, **kw)
-                + "\n"
-            )
+        meta = {"source": self.config.raft_address}
+        text = rec.to_jsonl(meta=meta, **kw) + "\n"
+        if max_bytes and len(text) > max_bytes:
+            lines = text.splitlines(keepends=True)
+            head, tail = lines[0], lines[1:]  # _meta line stays first
+            size = len(head)
+            keep: List[str] = []
+            for ln in reversed(tail):  # newest-first fill
+                if size + len(ln) > max_bytes:
+                    break
+                keep.append(ln)
+                size += len(ln)
+            keep.reverse()
+            import json
+
+            # re-emit the meta header with the drop count
+            m = {
+                "event": "_meta",
+                "mono_offset": round(rec.mono_offset, 6),
+                "dropped_events": len(tail) - len(keep),
+            }
+            m.update(meta)
+            head = json.dumps(m, default=str, sort_keys=True) + "\n"
+            text = head + "".join(keep)
+        if os.path.exists(path) and not path.endswith(".gz"):
+            # gzip rotation: the previous artifact survives, compressed
+            try:
+                with open(path, "rb") as src, gzip.open(
+                    path + ".1.gz", "wb"
+                ) as dst:
+                    dst.write(src.read())
+            except OSError:
+                pass  # rotation is best-effort; the fresh dump matters more
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                f.write(text)
+        else:
+            with open(path, "w") as f:
+                f.write(text)
         return path
 
     @staticmethod
@@ -1070,6 +1130,23 @@ class NodeHost(IMessageHandler):
         if step_stats is not None:
             for name, v in step_stats().items():
                 self.metrics.set_gauge(f"engine_step_{name}", (0, 0), float(v))
+        # runtime device-sync / retrace audit (profile.py): total and
+        # out-of-seam transfer counts plus XLA compile events, so a stray
+        # sync or steady-state retrace is visible on the same dashboard
+        # that watches throughput (counter semantics, exported 1/s)
+        sa = sync_audit().snapshot()
+        self.metrics.set_gauge(
+            "engine_device_syncs_total", (0, 0),
+            float(sa["in_seam"] + sa["out_of_seam"]),
+        )
+        self.metrics.set_gauge(
+            "engine_device_syncs_out_of_seam", (0, 0),
+            float(sa["out_of_seam"]),
+        )
+        self.metrics.set_gauge(
+            "engine_compile_events_total", (0, 0),
+            float(compile_watch().total),
+        )
         # per-lane (cluster_id-labelled) introspection from the engine's
         # numpy mirrors: leader, term, commit gap, ticks since the last
         # leader change — zero device syncs (see VectorEngine.lane_stats)

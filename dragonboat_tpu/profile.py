@@ -1,0 +1,451 @@
+"""Perf attribution plane: step-phase spans, runtime device-sync audit,
+and JAX compile-event accounting.
+
+The engine step loop's wall time is the product this repo optimizes, and
+PR 1-5 taught the same lesson three times: a regression that does not
+fail a test quietly becomes the new baseline. This module makes the
+attribution itself a first-class, always-exported plane:
+
+  * ``PhasePlane`` — phase-scoped span histograms. The engines' stage
+    profilers (``trace.Profiler``) ride the existing
+    ``EngineConfig.profile_sample_ratio`` sampler; on sampled iterations
+    every stage duration is ALSO observed into an
+    ``engine_phase_seconds{engine=...,phase=...}`` histogram
+    (events.Histogram, Prometheus exposition via
+    ``NodeHost.write_health_metrics``), and at FULL sampling (ratio 1,
+    the bench/debug opt-in) recorded as a ``phase_span`` event in the
+    FlightRecorder so ``tools.timeline --spans`` renders them
+    interleaved with causal-trace stages — sparse production sampling
+    fills histograms only, never crowding the forensic ring. Unsampled
+    iterations stay allocation- and event-free (the profiler's
+    start/end no-op there).
+
+  * ``SyncAudit`` — the runtime twin of the static ``device-sync`` rule
+    family (analysis/rules_device.py). The blessed seam
+    (``VectorEngine._fetch_output``) self-reports each consolidated
+    transfer through ``note_seam_sync()`` (one integer add per step,
+    always on). ``install()`` additionally wraps ``jax.device_get`` /
+    ``jax.block_until_ready`` process-wide so any OTHER transfer is
+    counted with call-site attribution — a stray sync introduced at
+    runtime shows up in ``engine_device_syncs_*`` metrics and fails the
+    tier-1 assertion (tests/test_profile.py), not just the AST gate.
+
+  * ``CompileWatch`` — the runtime twin of the static ``retrace`` family:
+    a ``jax.monitoring`` listener counts every XLA backend compile, and
+    jitted functions registered by the engine (``make_step_fn``, the
+    activation scatters) expose their trace-cache sizes per function, so
+    a retrace in steady state is attributable to the function that
+    retraced (``engine_compile_events_total`` / per-function cache
+    gauges; ``bench.py`` folds the measurement-window delta into every
+    config's JSON and ``tools.perfdiff --gate`` fails on growth).
+
+jax is imported lazily (inside ``install()``) so this module — like the
+analysis package — stays importable in jax-free contexts
+(``tools.perfdiff`` reads bench JSONs without ever touching a backend).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, Optional, Tuple
+
+from .events import Histogram, write_histogram_series, _labels
+from .trace import flight_recorder
+
+# canonical step-phase vocabulary. The vector engine's step loop
+# (VectorEngine._run_once + _decode) times every stage of a kernel step;
+# bench.py zero-fills phase_breakdown over VECTOR_PHASES so the JSON
+# schema is stable even for configs where a phase never ran.
+VECTOR_PHASES = (
+    "pack",       # host-event staging -> inbox planes (one scatter/plane)
+    "dispatch",   # device_put of (inbox, ticks) + jitted step dispatch
+    "fetch",      # _fetch_output: THE consolidated device->host sync
+    "place",      # decode phase 0: payloads at device-assigned indexes
+    "send_rep",   # decode phase 1: Replicate sends (leave BEFORE fsync)
+    "save",       # decode phase 2: batched fsync save wave
+    "send_resp",  # decode phase 3: post-fsync sends (votes/acks/heartbeats)
+    "apply",      # decode phase 4: committed entries -> RSM task queues
+    "reads",      # decode phase 5: confirmed ReadIndex completions
+    "maintain",   # decode phase 6: catchup/snapshot/compaction maintenance
+    "deliver",    # bulk send/deliver seam (_dispatch_sends, sub-span of
+                  # the send/apply/reads phases it runs inside)
+)
+
+# the scalar ExecEngine worker loop's stages (trace.STAGES order), timed
+# by the same Profiler machinery so scalar/vector attribution reads on
+# one scale in the exposition and the bench JSON
+EXEC_PHASES = ("step", "fast_apply", "send", "save", "apply", "exec")
+
+_PREFIX = "dragonboat_tpu"
+
+
+class PhasePlane:
+    """Process-global phase-span sink: (engine, phase) -> Histogram plus
+    a FlightRecorder ``phase_span`` breadcrumb per sampled span.
+
+    Fed exclusively from trace.Profiler's SAMPLED branch (attach via
+    ``Profiler.attach_phase_plane``); the ``sampling`` argument mirrors
+    the caller's gate so the off path stays event-free and the lint's
+    telemetry rule can see the guard."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
+        # master switch for flight-recorder spans (timeline --spans);
+        # disable for tests that assert exact recorder contents
+        self.record_spans = True
+
+    def on_phase(
+        self,
+        engine: str,
+        phase: str,
+        dt: float,
+        sampling: bool,
+        spans: bool = True,
+    ) -> None:
+        """`sampling` mirrors the calling profiler's 1-in-N gate (off
+        path: nothing happens); `spans` is the producer's full-sampling
+        gate (trace.Profiler sets it only at ratio 1, the bench/debug
+        mode) — sparse production sampling fills histograms but must not
+        crowd the forensic ring's bounded history with phase_span
+        breadcrumbs."""
+        if sampling:
+            key = (engine, phase)
+            with self._mu:
+                h = self._hists.get(key)
+                if h is None:
+                    h = self._hists[key] = Histogram()
+            h.observe(dt)
+            if spans and self.record_spans:
+                flight_recorder().record(
+                    "phase_span", engine=engine, phase=phase,
+                    dur=round(dt, 9),
+                )
+
+    def histogram(self, engine: str, phase: str) -> Optional[Histogram]:
+        with self._mu:
+            return self._hists.get((engine, phase))
+
+    def total_observations(self) -> int:
+        with self._mu:
+            hists = list(self._hists.values())
+        return sum(h.count for h in hists)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """(engine, phase) -> {count, sum_s, p50_s, p99_s} for tooling."""
+        with self._mu:
+            items = list(self._hists.items())
+        out: Dict[str, Dict[str, float]] = {}
+        for (engine, phase), h in items:
+            out[f"{engine}/{phase}"] = {
+                "count": float(h.count),
+                "sum_s": round(h.sum, 6),
+                "p50_s": round(h.quantile(0.5), 6),
+                "p99_s": round(h.quantile(0.99), 6),
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self._hists.clear()
+
+    def write(self, w, prefix: str = _PREFIX) -> None:
+        """Prometheus exposition: one ``engine_phase_seconds`` histogram
+        family, series labelled {engine=...,phase=...}."""
+        with self._mu:
+            items = sorted(self._hists.items())
+        if not items:
+            return
+        full = f"{prefix}_engine_phase_seconds"
+        w.write(f"# TYPE {full} histogram\n")
+        for (engine, phase), h in items:
+            write_histogram_series(
+                w, full, (("engine", engine), ("phase", phase)), h
+            )
+
+
+class SyncAudit:
+    """Runtime device->host transfer accounting.
+
+    The blessed seam (``VectorEngine._fetch_output``) self-reports via
+    ``note_seam_sync()`` unconditionally — one integer add per engine
+    step. ``install()`` wraps ``jax.device_get`` and
+    ``jax.block_until_ready`` so every call NOT made from a blessed
+    frame is counted under its call site (``file.py:line:function``).
+    Wrapping only patches the public ``jax`` attributes, so jax's own
+    internals (which bind ``jax._src`` symbols directly) are unaffected;
+    per-call overhead is one frame probe — noise next to the transfer
+    itself."""
+
+    # (path suffix, function name) pairs whose frames are the blessed
+    # transfer seam — mirrors analysis/targets.blessed_device_get
+    BLESSED = (("engine/vector.py", "_fetch_output"),)
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.seam = 0  # blessed-seam transfers (note_seam_sync)
+        self._out: Dict[str, int] = {}
+        self.installed = False
+        self._orig_get = None
+        self._orig_block = None
+
+    # ------------------------------------------------------------- seam
+    def note_seam(self) -> None:
+        # GIL-atomic-enough: telemetry, not accounting
+        self.seam += 1
+
+    # ------------------------------------------------------------ wraps
+    def install(self) -> "SyncAudit":
+        if self.installed:
+            return self
+        import jax
+
+        self._orig_get = orig_get = jax.device_get
+        self._orig_block = orig_block = jax.block_until_ready
+
+        def device_get(x, *a, **k):
+            self._note_frame(sys._getframe(1))
+            return orig_get(x, *a, **k)
+
+        def block_until_ready(x, *a, **k):
+            self._note_frame(sys._getframe(1))
+            return orig_block(x, *a, **k)
+
+        jax.device_get = device_get
+        jax.block_until_ready = block_until_ready
+        self.installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        import jax
+
+        jax.device_get = self._orig_get
+        jax.block_until_ready = self._orig_block
+        self._orig_get = self._orig_block = None
+        self.installed = False
+
+    def _note_frame(self, frame) -> None:
+        co = frame.f_code
+        fname = co.co_filename.replace(os.sep, "/")
+        for suffix, name in self.BLESSED:
+            if co.co_name == name and fname.endswith(suffix):
+                return  # the seam counts itself via note_seam()
+        # package-internal sites keep their package-relative path so the
+        # attribution names the offending module, not just a basename
+        idx = fname.rfind("/dragonboat_tpu/")
+        rel = fname[idx + 1 :] if idx >= 0 else os.path.basename(fname)
+        site = f"{rel}:{frame.f_lineno}:{co.co_name}"
+        with self._mu:
+            self._out[site] = self._out.get(site, 0) + 1
+
+    # --------------------------------------------------------- snapshots
+    def snapshot(self) -> dict:
+        with self._mu:
+            sites = dict(self._out)
+        return {
+            "in_seam": self.seam,
+            "out_of_seam": sum(sites.values()),
+            "sites": sites,
+        }
+
+    def out_of_seam_in_package(self) -> Dict[str, int]:
+        """Out-of-seam sites attributed to dragonboat_tpu code only (the
+        tier-1 assertion's subject; test/bench harness sites excluded)."""
+        with self._mu:
+            return {
+                s: n
+                for s, n in self._out.items()
+                if s.startswith("dragonboat_tpu/")
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._out.clear()
+        self.seam = 0
+
+
+def diff_sync(before: dict, after: dict) -> dict:
+    """Per-window delta of two SyncAudit.snapshot() dicts (bench folds
+    the measurement window's delta, not process-lifetime totals)."""
+    sites = {
+        s: n - before.get("sites", {}).get(s, 0)
+        for s, n in after.get("sites", {}).items()
+        if n - before.get("sites", {}).get(s, 0) > 0
+    }
+    return {
+        "in_seam": after["in_seam"] - before["in_seam"],
+        "out_of_seam": after["out_of_seam"] - before["out_of_seam"],
+        "sites": sites,
+    }
+
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileWatch:
+    """XLA compile-event accounting: a global ``jax.monitoring`` duration
+    listener counts every backend compile (and its seconds), and jitted
+    functions registered by their owners expose ``_cache_size()`` so
+    growth is attributable per function. ``install()`` is idempotent;
+    the listener cannot be unregistered (jax.monitoring has no removal
+    API short of clearing everyone's), so it stays cheap: two adds per
+    compile, nothing per step."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.total = 0
+        self.total_s = 0.0
+        self._fns: Dict[str, list] = {}
+        self.installed = False
+
+    def install(self) -> "CompileWatch":
+        if self.installed:
+            return self
+        import jax.monitoring as monitoring
+
+        def _on_duration(event, duration, **kw):
+            if event == _COMPILE_EVENT:
+                with self._mu:
+                    self.total += 1
+                    self.total_s += duration
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        self.installed = True
+        return self
+
+    def register(self, name: str, fn):
+        """Track a jitted function's trace cache under ``name``; returns
+        ``fn`` so call sites can wrap in place. Functions without a
+        ``_cache_size`` probe (plain callables) are ignored. Held by
+        WEAK reference: the watch must never pin a dead engine's
+        compiled executables (falls back to a strong ref only for the
+        rare non-weakrefable callable)."""
+        if not hasattr(fn, "_cache_size"):
+            return fn
+        import weakref
+
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:
+            ref = lambda _fn=fn: _fn  # noqa: E731 - constant closure
+        with self._mu:
+            refs = self._fns.setdefault(name, [])
+            if all(r() is not fn for r in refs):
+                refs.append(ref)
+        return fn
+
+    def per_function(self) -> Dict[str, int]:
+        with self._mu:
+            items = {k: list(v) for k, v in self._fns.items()}
+        out: Dict[str, int] = {}
+        dead: Dict[str, list] = {}
+        for name, refs in sorted(items.items()):
+            n = 0
+            for r in refs:
+                f = r()
+                if f is None:
+                    dead.setdefault(name, []).append(r)
+                    continue
+                try:
+                    n += int(f._cache_size())
+                except Exception:
+                    pass  # a deleted executable must not break telemetry
+            out[name] = n
+        if dead:
+            with self._mu:
+                for name, gone in dead.items():
+                    refs = self._fns.get(name)
+                    if refs is None:
+                        continue
+                    self._fns[name] = [r for r in refs if r not in gone]
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "total": self.total,
+            "total_s": round(self.total_s, 4),
+            "per_function": self.per_function(),
+        }
+
+    def reset_counts(self) -> None:
+        with self._mu:
+            self.total = 0
+            self.total_s = 0.0
+
+
+def diff_compiles(before: dict, after: dict) -> dict:
+    """Measurement-window delta of two CompileWatch.snapshot() dicts:
+    steady state compiles nothing, so any positive delta IS a retrace."""
+    per = {
+        k: n - before.get("per_function", {}).get(k, 0)
+        for k, n in after.get("per_function", {}).items()
+        if n - before.get("per_function", {}).get(k, 0) > 0
+    }
+    return {
+        "total": after["total"] - before["total"],
+        "total_s": round(after["total_s"] - before["total_s"], 4),
+        "per_function": per,
+    }
+
+
+# ---------------------------------------------------------------------------
+# process-global singletons (like trace.flight_recorder: every engine and
+# NodeHost in the process feeds one plane, and the exposition/bench folds
+# read it without plumbing)
+# ---------------------------------------------------------------------------
+
+_phase_plane = PhasePlane()
+_sync_audit = SyncAudit()
+_compile_watch = CompileWatch()
+
+
+def phase_plane() -> PhasePlane:
+    return _phase_plane
+
+
+def sync_audit() -> SyncAudit:
+    return _sync_audit
+
+
+def compile_watch() -> CompileWatch:
+    return _compile_watch
+
+
+def note_seam_sync() -> None:
+    """The blessed ``_fetch_output`` seam's self-report: one integer add
+    per consolidated device->host transfer, always on."""
+    _sync_audit.seam += 1
+
+
+def write_exposition(w, prefix: str = _PREFIX) -> None:
+    """Append the attribution plane to a Prometheus text exposition:
+    the ``engine_phase_seconds`` histograms plus per-jitted-function
+    compile-cache gauges (scalar device-sync/compile counters ride the
+    NodeHost MetricsRegistry as ``engine_device_syncs_*`` /
+    ``engine_compile_events_total``)."""
+    _phase_plane.write(w, prefix)
+    per_fn = _compile_watch.per_function()
+    if per_fn:
+        full = f"{prefix}_engine_compile_cache_entries"
+        w.write(f"# TYPE {full} gauge\n")
+        for name, n in sorted(per_fn.items()):
+            w.write(f"{full}{_labels((('function', name),))} {n}\n")
+
+
+__all__ = [
+    "CompileWatch",
+    "EXEC_PHASES",
+    "PhasePlane",
+    "SyncAudit",
+    "VECTOR_PHASES",
+    "compile_watch",
+    "diff_compiles",
+    "diff_sync",
+    "note_seam_sync",
+    "phase_plane",
+    "sync_audit",
+    "write_exposition",
+]

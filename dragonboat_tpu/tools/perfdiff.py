@@ -1,0 +1,385 @@
+"""`python -m dragonboat_tpu.tools.perfdiff` — the bench regression gate.
+
+Compares two bench JSON records (the single line `bench.py` prints, saved
+to a file — the `BENCH_r0x.json` trajectory format) per config and per
+phase, and in `--gate` mode exits non-zero on regression: the CI gate
+this repo's perf trajectory never had.
+
+    python -m dragonboat_tpu.tools.perfdiff OLD.json NEW.json
+    python -m dragonboat_tpu.tools.perfdiff OLD.json NEW.json --gate \\
+        --threshold-pct 20
+    python -m dragonboat_tpu.tools.perfdiff .          # BENCH_* trajectory
+    python -m dragonboat_tpu.tools.perfdiff A.json B.json --json
+
+What is compared, per config present in BOTH records:
+
+  * headline `value` (proposals/s; a drop >= threshold is a regression)
+  * `phase_breakdown` — per-phase host seconds from the step-phase
+    profiler (dragonboat_tpu.profile); a phase that grows >= threshold
+    (and by at least `--min-seconds`, the absolute noise floor) is a
+    regression. Records that predate `phase_breakdown` fall back to
+    `host_stage_total_s`; only phases present in both are compared.
+  * `device_syncs.out_of_seam` — any NEW out-of-seam device sync is a
+    regression (the runtime twin of the `device-sync` lint family).
+  * `compile_events.per_function` — any growth in measurement-window
+    retraces of the WATCHED jitted functions (step kernel, activation
+    scatters) is a regression (the runtime twin of the `retrace`
+    family); the raw compile `total` is reported but not gated — rare
+    maintenance ops may lazily compile once inside any window.
+
+Honesty rule: a config stamped `scaled_down` (it ran fewer groups than
+its `nominal_groups` regime) is NOT comparable against a nominal run of
+the same config — the numbers measure different workloads. perfdiff
+refuses (verdict `incomparable`, exit code 2) instead of printing a
+delta that would be read as a regression or a win.
+
+Exit codes: 0 = pass, 1 = regression (with --gate), 2 = incomparable.
+
+Directory mode: a single directory argument collects `BENCH_*.json`
+(sorted), prints the delta for every consecutive pair, and gates on the
+LAST pair — the newest step of the trajectory.
+
+jax-free by design (reads JSON only): usable as a pre-merge hook on any
+box, like `tools.check`.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD_PCT = 20.0
+DEFAULT_MIN_SECONDS = 0.001
+
+PASS = "pass"
+FAIL = "fail"
+INCOMPARABLE = "incomparable"
+
+
+def _record_from_text(text: str) -> Optional[dict]:
+    """First parseable JSON object line that looks like a bench record
+    (tolerates surrounding log noise)."""
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and ("configs" in d or "metric" in d):
+            return d
+    return None
+
+
+def load_record(path: str) -> dict:
+    """A bench record: either the single line bench.py prints, or a CI
+    wrapper object (the checked-in BENCH_r0x trajectory) whose `tail`
+    string embeds that line among the run's log output."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except ValueError:
+        d = None
+    if isinstance(d, dict):
+        if "configs" in d or "metric" in d:
+            return d
+        parsed = d.get("parsed")
+        if isinstance(parsed, dict) and ("configs" in parsed or "metric" in parsed):
+            return parsed
+        tail = d.get("tail")
+        if isinstance(tail, str):
+            r = _record_from_text(tail)
+            if r is not None:
+                return r
+    r = _record_from_text(text)
+    if r is not None:
+        return r
+    raise ValueError(f"{path}: no bench JSON record found")
+
+
+def _phases(cfg: dict) -> Tuple[Dict[str, float], bool]:
+    """(phase totals, legacy flag). Legacy = pre-attribution-plane
+    records whose host_stage_total_s used the old stage vocabulary."""
+    pb = cfg.get("phase_breakdown")
+    if isinstance(pb, dict):
+        return {k: float(v) for k, v in pb.items()}, False
+    hs = cfg.get("host_stage_total_s")
+    if isinstance(hs, dict):
+        return {k: float(v) for k, v in hs.items()}, True
+    return {}, True
+
+
+def _normalize_legacy(
+    legacy: Dict[str, float], modern: Dict[str, float]
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Align a legacy record's stage vocabulary with a modern one so the
+    diff compares like with like across the PR 6 rename boundary: the
+    old 'step' stage IS the new 'fetch' (the _fetch_output sync), and
+    the old 'apply' covered decode phases 4 AND 5, so the modern side's
+    'apply'+'reads' fold together and 'reads' drops."""
+    leg = dict(legacy)
+    mod = dict(modern)
+    if "fetch" not in leg and "step" in leg:
+        leg["fetch"] = leg.pop("step")
+    if "reads" in mod and "reads" not in leg:
+        mod["apply"] = mod.get("apply", 0.0) + mod.pop("reads")
+    return leg, mod
+
+
+def _scaled(cfg: dict) -> bool:
+    return bool(cfg.get("scaled_down"))
+
+
+def phase_regressed(
+    old: float, new: float, threshold_pct: float, min_seconds: float
+) -> bool:
+    """The gate's per-phase rule: a regression must clear BOTH the
+    relative threshold and an absolute floor (sub-millisecond jitter on
+    a near-zero phase is noise, not a regression); a phase growing from
+    zero past the floor is always a regression."""
+    if new - old < min_seconds:
+        return False
+    if old <= 0.0:
+        return True
+    return (new - old) / old * 100.0 >= threshold_pct
+
+
+def _pct(old: float, new: float) -> Optional[float]:
+    if old == 0.0:
+        return None
+    return round((new - old) / old * 100.0, 1)
+
+
+def compare_config(
+    old: dict,
+    new: dict,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+    min_seconds: float = DEFAULT_MIN_SECONDS,
+) -> dict:
+    """Compare one ladder config's old/new records; returns the verdict,
+    the reasons behind it, and the per-dimension deltas."""
+    reasons: List[str] = []
+    # ---- honesty: scaled-down vs nominal is not a comparison ----------
+    if _scaled(old) != _scaled(new):
+        which, scaled = ("old", old) if _scaled(old) else ("new", new)
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"scaled_down mismatch: the {which} run stands in "
+                f"{scaled.get('actual_groups', scaled.get('groups'))} "
+                f"groups for a nominal {scaled.get('nominal_groups')}-group "
+                f"regime; deltas would compare different workloads"
+            ],
+        }
+    oa = old.get("actual_groups", old.get("groups"))
+    na = new.get("actual_groups", new.get("groups"))
+    if _scaled(old) and oa != na:
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"both runs scaled down, but to different group counts "
+                f"({oa} vs {na})"
+            ],
+        }
+    out: dict = {"verdict": PASS, "reasons": reasons}
+    # ---- headline throughput ------------------------------------------
+    ov, nv = float(old.get("value", 0.0)), float(new.get("value", 0.0))
+    out["value"] = {"old": ov, "new": nv, "delta_pct": _pct(ov, nv)}
+    if ov > 0 and (ov - nv) / ov * 100.0 >= threshold_pct:
+        reasons.append(
+            f"throughput regressed {((ov - nv) / ov * 100.0):.1f}% "
+            f"({ov:.0f} -> {nv:.0f} proposals/s)"
+        )
+    # ---- per-phase host seconds ---------------------------------------
+    op, old_legacy = _phases(old)
+    np_, new_legacy = _phases(new)
+    if old_legacy and not new_legacy:
+        op, np_ = _normalize_legacy(op, np_)
+    elif new_legacy and not old_legacy:
+        np_, op = _normalize_legacy(np_, op)
+    phases: Dict[str, dict] = {}
+    for name in sorted(set(op) & set(np_)):
+        o, n = op[name], np_[name]
+        phases[name] = {"old": o, "new": n, "delta_pct": _pct(o, n)}
+        if phase_regressed(o, n, threshold_pct, min_seconds):
+            phases[name]["regressed"] = True
+            reasons.append(
+                f"phase '{name}' regressed "
+                f"{'from zero' if o <= 0 else f'{(n - o) / o * 100.0:.1f}%'}"
+                f" ({o:.4f}s -> {n:.4f}s)"
+            )
+    out["phases"] = phases
+    # ---- runtime sync/retrace audit -----------------------------------
+    ods, nds = old.get("device_syncs"), new.get("device_syncs")
+    if isinstance(ods, dict) and isinstance(nds, dict):
+        o, n = int(ods.get("out_of_seam", 0)), int(nds.get("out_of_seam", 0))
+        out["device_syncs"] = {"old_out_of_seam": o, "new_out_of_seam": n}
+        if n > o:
+            sites = nds.get("sites") or {}
+            reasons.append(
+                f"out-of-seam device syncs grew {o} -> {n}"
+                + (f" (sites: {sorted(sites)[:3]})" if sites else "")
+            )
+    oce, nce = old.get("compile_events"), new.get("compile_events")
+    if isinstance(oce, dict) and isinstance(nce, dict):
+        # gate on REGISTERED jitted functions' retraces (per_function
+        # carries the window's cache-size growth of the step kernel /
+        # activation scatters); raw `total` stays informational — a
+        # one-time lazy compile of a rare maintenance op can land inside
+        # any window and is not a retrace
+        o = sum((oce.get("per_function") or {}).values())
+        n = sum((nce.get("per_function") or {}).values())
+        out["compile_events"] = {
+            "old_total": int(oce.get("total", 0)),
+            "new_total": int(nce.get("total", 0)),
+            "old_retraces": o,
+            "new_retraces": n,
+        }
+        if n > o:
+            per = nce.get("per_function") or {}
+            reasons.append(
+                f"window retraces of watched jitted functions grew "
+                f"{o} -> {n}"
+                + (f" (functions: {sorted(per)[:3]})" if per else "")
+            )
+    if reasons:
+        out["verdict"] = FAIL
+    return out
+
+
+def compare(
+    old: dict,
+    new: dict,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+    min_seconds: float = DEFAULT_MIN_SECONDS,
+) -> dict:
+    """Whole-record comparison over the configs present in both; the
+    overall verdict is incomparable > fail > pass."""
+    oc = old.get("configs") or {}
+    nc = new.get("configs") or {}
+    configs: Dict[str, dict] = {}
+    for cid in sorted(set(oc) & set(nc), key=str):
+        a, b = oc[cid], nc[cid]
+        if "error" in a or "error" in b:
+            configs[cid] = {
+                "verdict": INCOMPARABLE,
+                "reasons": ["one of the runs recorded an error"],
+            }
+            continue
+        configs[cid] = compare_config(a, b, threshold_pct, min_seconds)
+    verdict = PASS
+    if any(c["verdict"] == FAIL for c in configs.values()):
+        verdict = FAIL
+    if any(c["verdict"] == INCOMPARABLE for c in configs.values()):
+        verdict = INCOMPARABLE
+    return {
+        "verdict": verdict,
+        "threshold_pct": threshold_pct,
+        "min_seconds": min_seconds,
+        "configs": configs,
+    }
+
+
+def render(report: dict, old_name: str = "old", new_name: str = "new") -> str:
+    lines = [f"perfdiff {old_name} -> {new_name}"]
+    for cid, c in sorted(report["configs"].items(), key=lambda kv: kv[0]):
+        lines.append(f"  config {cid}: {c['verdict'].upper()}")
+        v = c.get("value")
+        if v:
+            d = v["delta_pct"]
+            lines.append(
+                f"    value: {v['old']:.1f} -> {v['new']:.1f}"
+                + (f" ({d:+.1f}%)" if d is not None else "")
+            )
+        for name, p in sorted(c.get("phases", {}).items()):
+            d = p["delta_pct"]
+            mark = "  << REGRESSED" if p.get("regressed") else ""
+            lines.append(
+                f"    phase {name:<10} {p['old']:.4f}s -> {p['new']:.4f}s"
+                + (f" ({d:+.1f}%)" if d is not None else "")
+                + mark
+            )
+        for r in c.get("reasons", []):
+            lines.append(f"    ! {r}")
+    lines.append(f"verdict: {report['verdict'].upper()}")
+    return "\n".join(lines)
+
+
+def _exit_code(report: dict, gate: bool) -> int:
+    if report["verdict"] == INCOMPARABLE:
+        return 2  # refusal is unconditional: a non-comparison is not a pass
+    if gate and report["verdict"] == FAIL:
+        return 1
+    return 0
+
+
+def _trajectory(dirpath: str) -> List[str]:
+    paths = sorted(glob.glob(os.path.join(dirpath, "BENCH_*.json")))
+    if len(paths) < 2:
+        paths = sorted(glob.glob(os.path.join(dirpath, "*.json")))
+    return paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dragonboat_tpu.tools.perfdiff",
+        description="per-config, per-phase bench regression gate",
+    )
+    ap.add_argument(
+        "paths", nargs="+",
+        help="two bench JSON files, or ONE directory of BENCH_*.json",
+    )
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 on regression (2 on incomparable runs)")
+    ap.add_argument("--threshold-pct", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="relative regression threshold per phase/value")
+    ap.add_argument("--min-seconds", type=float, default=DEFAULT_MIN_SECONDS,
+                    help="absolute per-phase noise floor in seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison report as JSON")
+    args = ap.parse_args(argv)
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        paths = []
+        for p in _trajectory(args.paths[0]):
+            try:
+                load_record(p)
+            except ValueError:
+                # a failed run leaves a wrapper with no record (e.g. the
+                # trajectory's rc!=0 entries): skip it, keep the axis
+                print(f"skipping {p}: no bench record", file=sys.stderr)
+                continue
+            paths.append(p)
+        if len(paths) < 2:
+            print(f"{args.paths[0]}: fewer than two bench JSONs",
+                  file=sys.stderr)
+            return 2
+    elif len(args.paths) == 2:
+        paths = args.paths
+    else:
+        ap.error("pass exactly two bench JSON files or one directory")
+        return 2  # unreachable (error raises); keeps the type checker calm
+    reports = []
+    for a, b in zip(paths, paths[1:]):
+        rep = compare(
+            load_record(a), load_record(b),
+            threshold_pct=args.threshold_pct, min_seconds=args.min_seconds,
+        )
+        reports.append((a, b, rep))
+        if args.json:
+            out = dict(rep)
+            out["old"], out["new"] = a, b
+            print(json.dumps(out, sort_keys=True))
+        else:
+            print(render(rep, os.path.basename(a), os.path.basename(b)))
+    # the gate rides the LAST pair: the trajectory's newest step
+    return _exit_code(reports[-1][2], args.gate)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,23 +18,33 @@ Usage:
 
     python -m dragonboat_tpu.tools.timeline n1.jsonl n2.jsonl n3.ring \\
         [--cluster 2] [--trace 0x1c0ffee00000001] [--event leader_changed]
-        [--chains] [--json]
+        [--chains] [--spans] [--json]
 
 `--chains` groups the filtered events by trace id and prints each
 proposal's causal chain (propose_enqueue -> replicate_send ->
 replicate_recv -> quorum_commit -> proposal_applied) with per-stage
 deltas — the view that turns a chaos seed's `CHAOS_SEED` + `.pytest_flight/`
 artifacts into "what did this proposal actually do, on which node, when".
+
+`--spans` renders the step-phase profiler's `phase_span` events (see
+dragonboat_tpu.profile) as duration bars ordered by span START,
+interleaved with the causal-trace stage events — "which engine phase was
+running while this proposal committed". Gzip-compressed JSONL dumps
+(`NodeHost.dump_flight` rotation artifacts, or dumps written straight to
+a `.gz` path) are read transparently.
 """
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
 import os
 import sys
 from typing import Dict, List, Optional
 
 from ..trace import _RING_MAGIC, read_mmap_ring
+
+_GZIP_MAGIC = b"\x1f\x8b"
 
 # stages in causal order, for chain rendering (unknown events sort by time)
 CHAIN_STAGES = (
@@ -55,16 +65,26 @@ def _is_ring(path: str) -> bool:
         return False
 
 
+def _open_text(path: str):
+    """Open a JSONL dump for reading, decompressing gzip transparently
+    (detected by magic, not extension — rotation artifacts keep working
+    however they were named)."""
+    with open(path, "rb") as f:
+        if f.read(2) == _GZIP_MAGIC:
+            return gzip.open(path, "rt")
+    return open(path)
+
+
 def load_dump(path: str) -> List[dict]:
-    """Load one artifact (JSONL dump or mmap ring) into normalized events:
-    each event gains `_src` (which dump it came from) and `_tw` (wall-clock
-    time, the cross-process merge axis)."""
+    """Load one artifact (JSONL dump — plain or gzipped — or mmap ring)
+    into normalized events: each event gains `_src` (which dump it came
+    from) and `_tw` (wall-clock time, the cross-process merge axis)."""
     if _is_ring(path):
         meta, events = read_mmap_ring(path)
     else:
         meta = {"mono_offset": 0.0, "source": os.path.basename(path)}
         events = []
-        with open(path) as f:
+        with _open_text(path) as f:
             for ln in f:
                 ln = ln.strip()
                 if not ln:
@@ -151,6 +171,41 @@ def format_timeline(events: List[dict], out=None) -> None:
         )
 
 
+def format_spans(events: List[dict], out=None) -> None:
+    """Span-aware timeline: `phase_span` events are recorded at span END
+    carrying `dur`, so each is re-anchored to its START and printed as a
+    duration bar, interleaved (by start time) with every other event in
+    the filtered set — the view that puts a proposal's causal stages
+    against the engine phases that carried them."""
+    out = out or sys.stdout
+    rows = []
+    for e in events:
+        if e.get("event") == "phase_span":
+            dur = float(e.get("dur", 0.0))
+            rows.append((e["_tw"] - dur, e, dur))
+        else:
+            rows.append((e["_tw"], e, None))
+    if not rows:
+        out.write("(no events)\n")
+        return
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0]
+    for start, e, dur in rows:
+        if dur is None:
+            tid = e.get("trace")
+            tag = f" trace={tid:#x}" if tid else ""
+            out.write(
+                f"+{start - t0:11.6f}s [{e['_src']}] "
+                f"{e['event']}{tag} {_fmt_fields(e)}\n"
+            )
+        else:
+            out.write(
+                f"+{start - t0:11.6f}s [{e['_src']}] "
+                f"|-- {e.get('engine', '?')}/{e.get('phase', '?')} "
+                f"{dur * 1e6:.1f}us --|\n"
+            )
+
+
 def format_chains(events: List[dict], out=None) -> int:
     """Pretty-print every causal chain in the events; returns the number
     of chains rendered."""
@@ -194,18 +249,30 @@ def main(argv=None) -> int:
                     help="only these event types (repeatable)")
     ap.add_argument("--chains", action="store_true",
                     help="group by trace id and print causal chains")
+    ap.add_argument("--spans", action="store_true",
+                    help="render step-phase profiler spans (phase_span "
+                         "events) as duration bars interleaved with the "
+                         "causal-trace stages")
     ap.add_argument("--json", action="store_true",
                     help="emit the merged, filtered events as JSONL")
     args = ap.parse_args(argv)
+    kinds = set(args.event) if args.event else None
+    if args.spans and kinds is None:
+        # default --spans view: the profiler spans against the causal
+        # chain stages (everything else stays reachable via --event)
+        kinds = set(CHAIN_STAGES) | {"phase_span"}
     events = filter_events(
         merge_dumps(args.paths),
         cluster=args.cluster,
         trace=args.trace,
-        kinds=set(args.event) if args.event else None,
+        kinds=kinds,
     )
     if args.json:
         for e in events:
             sys.stdout.write(json.dumps(e, default=str, sort_keys=True) + "\n")
+        return 0
+    if args.spans:
+        format_spans(events)
         return 0
     if args.chains:
         format_chains(events)

@@ -106,6 +106,23 @@ class Profiler:
         self.samples: Dict[str, Sample] = {s: Sample(s) for s in STAGES}
         self.batched_groups = Sample("batched_groups")
         self._t0: Optional[float] = None
+        # optional phase-span sink (profile.PhasePlane): sampled stage
+        # durations fan out to the engine_phase_seconds histograms and
+        # the flight recorder; unsampled iterations never reach it
+        self._plane = None
+        self._engine_kind = ""
+        self._span_gate = False
+
+    def attach_phase_plane(self, plane, engine_kind: str) -> None:
+        """Tee sampled stage durations into a profile.PhasePlane under
+        the given engine kind ("vector"/"exec"). Histograms fill at ANY
+        sampling ratio; flight-recorder span events only at FULL
+        sampling (ratio 1 — the bench/debug opt-in) so the sparse
+        production default can never flood the forensic ring's bounded
+        history with phase_span breadcrumbs."""
+        self._plane = plane
+        self._engine_kind = engine_kind
+        self._span_gate = self.ratio == 1
 
     def new_iteration(self, n_groups: int = 0) -> None:
         self._iter += 1
@@ -119,11 +136,34 @@ class Profiler:
 
     def end(self, stage: str) -> None:
         if self.sampling and self._t0 is not None:
+            dt = time.monotonic() - self._t0
             s = self.samples.get(stage)
             if s is None:
                 s = self.samples[stage] = Sample(stage)
-            s.record(time.monotonic() - self._t0)
+            s.record(dt)
+            if self._plane is not None:
+                self._plane.on_phase(
+                    self._engine_kind, stage, dt, self.sampling,
+                    spans=self._span_gate,
+                )
             self._t0 = None
+
+    def add(self, stage: str, dt: float) -> None:
+        """Record a sub-span the CALLER measured (no start/end pairing —
+        for spans nested inside another stage, e.g. the bulk deliver
+        seam inside the send phases). Sampled iterations only; callers
+        gate their own time.monotonic() pair on `self.sampling` so the
+        off path stays clock-read-free."""
+        if self.sampling:
+            s = self.samples.get(stage)
+            if s is None:
+                s = self.samples[stage] = Sample(stage)
+            s.record(dt)
+            if self._plane is not None:
+                self._plane.on_phase(
+                    self._engine_kind, stage, dt, self.sampling,
+                    spans=self._span_gate,
+                )
 
     def report(self) -> str:
         lines = [s.report() for s in self.samples.values() if len(s)]

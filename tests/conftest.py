@@ -36,6 +36,14 @@ def pytest_configure(config):
         "pre-commit check (same gate as `python -m "
         "dragonboat_tpu.tools.check`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: the perf-attribution gate — the tools.perfdiff regression "
+        "gate over the checked-in fixtures (sub-second, jax-free) plus "
+        "the runtime device-sync/retrace audit assertions over a live "
+        "vector-engine scenario; run it alone with `-m perf` alongside "
+        "the `-m lint` gate",
+    )
 
 
 # ---- hang diagnosis (the Python half of the race-detection story; see

@@ -1,0 +1,221 @@
+"""The bench regression gate (`tools.perfdiff`) over checked-in golden
+fixtures: pass on identical runs, fail on an injected >=20% phase
+regression, refuse (incomparable) a scaled-down run vs a nominal one —
+the three verdicts the `-m perf` tier-1 gate certifies, plus the
+directory (trajectory) mode and the honesty rules' unit semantics.
+
+jax-free and sub-second: perfdiff reads JSON only, like `tools.check`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from dragonboat_tpu.tools.perfdiff import (
+    FAIL,
+    INCOMPARABLE,
+    PASS,
+    compare,
+    compare_config,
+    load_record,
+    main,
+    phase_regressed,
+    render,
+)
+
+pytestmark = pytest.mark.perf
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DATA = os.path.join(_REPO, "tests", "data")
+BASE = os.path.join(_DATA, "perfdiff_base.json")
+REGRESS = os.path.join(_DATA, "perfdiff_regress.json")
+NOMINAL = os.path.join(_DATA, "perfdiff_nominal.json")
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "dragonboat_tpu.tools.perfdiff", *args],
+        cwd=_REPO, capture_output=True, text=True, timeout=60,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the three gate verdicts (acceptance criteria), via the real CLI
+# ---------------------------------------------------------------------------
+
+
+def test_gate_identical_runs_exit_zero():
+    p = _cli(BASE, BASE, "--gate")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PASS" in p.stdout
+
+
+def test_gate_flags_injected_phase_regression():
+    """The regress fixture's config-1 'save' phase grew 2.0s -> 2.6s
+    (+30% >= the 20% default threshold): non-zero exit, and the output
+    names the phase."""
+    p = _cli(BASE, REGRESS, "--gate")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "save" in p.stdout
+    assert "REGRESSED" in p.stdout
+    assert "FAIL" in p.stdout
+
+
+def test_gate_threshold_is_honored():
+    # at a 40% threshold the +30% save growth is not a regression
+    p = _cli(BASE, REGRESS, "--gate", "--threshold-pct", "40")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_refuses_scaled_down_vs_nominal():
+    """Bench honesty: config 3 ran 256 groups standing in for the 10k
+    nominal regime in the base fixture, and at nominal scale in the
+    other — comparing them would measure different workloads. Exit 2,
+    gate or not."""
+    for extra in ((), ("--gate",)):
+        p = _cli(BASE, NOMINAL, *extra)
+        assert p.returncode == 2, p.stdout + p.stderr
+        assert "INCOMPARABLE" in p.stdout
+        assert "scaled_down" in p.stdout
+
+
+def test_json_report_shape():
+    p = _cli(BASE, REGRESS, "--json")
+    rep = json.loads(p.stdout.splitlines()[0])
+    assert rep["verdict"] == FAIL
+    c1 = rep["configs"]["1"]
+    assert c1["verdict"] == FAIL
+    assert c1["phases"]["save"]["regressed"] is True
+    assert c1["phases"]["save"]["delta_pct"] == pytest.approx(30.0)
+    # untouched config stays comparable and clean
+    assert rep["configs"]["3"]["verdict"] == PASS
+
+
+# ---------------------------------------------------------------------------
+# API semantics
+# ---------------------------------------------------------------------------
+
+
+def test_phase_regression_rule():
+    # relative threshold
+    assert phase_regressed(1.0, 1.3, 20.0, 0.001)
+    assert not phase_regressed(1.0, 1.1, 20.0, 0.001)
+    # absolute noise floor: a near-zero phase jittering stays clean...
+    assert not phase_regressed(0.0001, 0.0005, 20.0, 0.001)
+    # ...but growth from zero past the floor is always a regression
+    assert phase_regressed(0.0, 0.01, 20.0, 0.001)
+    # improvements never regress
+    assert not phase_regressed(2.0, 1.0, 20.0, 0.001)
+
+
+def test_out_of_seam_sync_growth_fails():
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["device_syncs"]["out_of_seam"] = 3
+    b["device_syncs"]["sites"] = {"engine/vector.py:9:_decode": 3}
+    r = compare_config(a, b)
+    assert r["verdict"] == FAIL
+    assert any("out-of-seam" in s for s in r["reasons"])
+
+
+def test_watched_function_retrace_fails():
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["compile_events"]["total"] = 3
+    b["compile_events"]["per_function"] = {"step_batch[g4]": 3}
+    r = compare_config(a, b)
+    assert r["verdict"] == FAIL
+    assert any("retraces" in s for s in r["reasons"])
+
+
+def test_unwatched_lazy_compile_does_not_gate():
+    """A one-time lazy compile of a rare maintenance op (total grows,
+    no watched function retraced) is NOT a regression — it would make
+    the gate flaky across warm/cold compile caches."""
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["compile_events"]["total"] = 1
+    r = compare_config(a, b)
+    assert r["verdict"] == PASS
+
+
+def test_both_scaled_to_different_widths_incomparable():
+    a = load_record(BASE)["configs"]["3"]
+    b = json.loads(json.dumps(a))
+    b["actual_groups"] = 128
+    r = compare_config(a, b)
+    assert r["verdict"] == INCOMPARABLE
+
+
+def test_throughput_drop_fails():
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["value"] = a["value"] * 0.7  # -30%
+    r = compare_config(a, b)
+    assert r["verdict"] == FAIL
+    assert any("throughput" in s for s in r["reasons"])
+
+
+def test_legacy_vs_modern_normalizes_renamed_phases():
+    """Across the PR 6 rename boundary a legacy record's 'step' stage is
+    the modern 'fetch', and its 'apply' covered decode phases 4+5 — so
+    the modern side's apply+reads fold together. A real fetch/apply
+    regression must not hide behind the vocabulary change."""
+    legacy = {"configs": {"2": {"value": 100.0, "host_stage_total_s": {
+        "step": 1.0, "apply": 1.0, "save": 0.5}}}}
+    modern = {"configs": {"2": {"value": 100.0, "phase_breakdown": {
+        "fetch": 1.5, "apply": 0.9, "reads": 0.4, "save": 0.5}}}}
+    rep = compare(legacy, modern)
+    c = rep["configs"]["2"]
+    # old 'step' diffed against new 'fetch': +50% -> regression
+    assert c["phases"]["fetch"]["regressed"] is True
+    # old combined apply(1.0) vs new apply+reads(1.3): +30% -> regression
+    assert c["phases"]["apply"]["regressed"] is True
+    assert "reads" not in c["phases"]
+    assert not c["phases"]["save"].get("regressed")
+
+
+def test_legacy_records_fall_back_to_host_stage_totals():
+    """Pre-attribution-plane BENCH records carry host_stage_total_s but
+    no phase_breakdown: the shared phases still diff."""
+    a = {"configs": {"2": {"value": 100.0,
+                           "host_stage_total_s": {"save": 1.0, "pack": 0.5}}}}
+    b = {"configs": {"2": {"value": 100.0,
+                           "host_stage_total_s": {"save": 1.5, "pack": 0.5}}}}
+    rep = compare(a, b)
+    assert rep["verdict"] == FAIL
+    assert rep["configs"]["2"]["phases"]["save"]["regressed"] is True
+    assert "save" in render(rep)
+
+
+def test_trajectory_directory_mode(tmp_path):
+    """One directory argument: consecutive BENCH_*.json pairs diff, the
+    gate rides the newest pair."""
+    shutil.copy(BASE, tmp_path / "BENCH_r01.json")
+    shutil.copy(BASE, tmp_path / "BENCH_r02.json")
+    shutil.copy(REGRESS, tmp_path / "BENCH_r03.json")
+    assert main([str(tmp_path), "--gate"]) == 1
+    # with the regression as the OLDER step and a recovery as newest,
+    # the gate passes (it certifies the newest transition)
+    shutil.copy(BASE, tmp_path / "BENCH_r04.json")
+    assert main([str(tmp_path), "--gate"]) == 0
+
+
+def test_real_bench_trajectory_is_loadable():
+    """The checked-in BENCH_r0x trajectory parses and diffs (legacy
+    schema: no phase_breakdown, no gate expectations — just no crash)."""
+    paths = sorted(
+        os.path.join(_REPO, f)
+        for f in os.listdir(_REPO)
+        if f.startswith("BENCH_r") and f.endswith(".json")
+    )
+    if len(paths) < 2:
+        pytest.skip("no trajectory checked in")
+    rep = compare(load_record(paths[-2]), load_record(paths[-1]))
+    assert rep["verdict"] in (PASS, FAIL, INCOMPARABLE)
+    assert render(rep)

@@ -1,0 +1,388 @@
+"""Perf attribution plane tests (dragonboat_tpu.profile).
+
+Four subjects:
+
+  * sampling discipline — unsampled profiler iterations must stay
+    allocation- and event-free with the phase plane wired in (zero
+    recorder events, zero Histogram observations on the off path);
+  * the runtime device-sync audit — call-site attribution, blessed-seam
+    classification, install/uninstall hygiene;
+  * the compile watch — per-jitted-function retrace attribution;
+  * the tier-1 acceptance assertion (`-m perf`): a live vector-engine
+    scenario performs ZERO out-of-seam device syncs and ZERO
+    steady-state XLA compiles, while the phase plane, the gauges and the
+    Prometheus exposition all carry the attribution.
+"""
+from __future__ import annotations
+
+import gzip
+import io
+import json
+import os
+import time
+
+import pytest
+
+from dragonboat_tpu.profile import (
+    EXEC_PHASES,
+    VECTOR_PHASES,
+    PhasePlane,
+    compile_watch,
+    diff_compiles,
+    diff_sync,
+    phase_plane,
+    sync_audit,
+    write_exposition,
+)
+from dragonboat_tpu.trace import Profiler, flight_recorder
+
+
+# ---------------------------------------------------------------------------
+# sampling discipline (satellite: the off path stays event-free)
+# ---------------------------------------------------------------------------
+
+
+def test_unsampled_iterations_stay_event_free():
+    plane = PhasePlane()
+    prof = Profiler(sample_ratio=4)
+    prof.attach_phase_plane(plane, "vector")
+    rec = flight_recorder()
+    rec.reset()
+    for _ in range(3):  # iterations 1..3 of ratio 4: never sampled
+        prof.new_iteration(1)
+        assert not prof.sampling
+        prof.start()
+        prof.end("pack")
+        prof.add("deliver", 0.001)
+    assert plane.total_observations() == 0, "histogram observed off-path"
+    assert len(rec) == 0, "recorder event on the unsampled path"
+    # iteration 4 IS sampled: histograms fill — but at SPARSE sampling
+    # no flight-recorder spans are emitted (they would crowd the ring's
+    # bounded forensic history at the always-on production default)
+    prof.new_iteration(1)
+    assert prof.sampling
+    prof.start()
+    prof.end("pack")
+    prof.add("deliver", 0.001)
+    assert plane.histogram("vector", "pack").count == 1
+    assert plane.histogram("vector", "deliver").count == 1
+    assert len(rec) == 0, "phase_span recorded at sparse sampling"
+
+
+def test_full_sampling_emits_recorder_spans():
+    """Spans reach the flight recorder only at ratio 1 (the bench/debug
+    opt-in, EngineConfig.profile_sample_ratio=1)."""
+    plane = PhasePlane()
+    prof = Profiler(sample_ratio=1)
+    prof.attach_phase_plane(plane, "vector")
+    rec = flight_recorder()
+    rec.reset()
+    prof.new_iteration(1)
+    prof.start()
+    prof.end("pack")
+    prof.add("deliver", 0.001)
+    events = [e for e in rec.dump() if e["event"] == "phase_span"]
+    assert {e["phase"] for e in events} == {"pack", "deliver"}
+    assert all(e["engine"] == "vector" for e in events)
+
+
+def test_phase_vocabulary_covers_both_engines():
+    # the canonical keys bench zero-fills; decode phases 0-6 all named
+    for p in ("pack", "dispatch", "fetch", "place", "send_rep", "save",
+              "send_resp", "apply", "reads", "maintain", "deliver"):
+        assert p in VECTOR_PHASES
+    for p in ("step", "fast_apply", "send", "save", "apply", "exec"):
+        assert p in EXEC_PHASES
+
+
+def test_plane_exposition_is_conformant():
+    from tests.test_observability import _parse_exposition
+
+    plane = PhasePlane()
+    plane.record_spans = False
+    plane.on_phase("vector", "pack", 0.002, True)
+    plane.on_phase("vector", "save", 0.004, True)
+    plane.on_phase("exec", "step", 0.001, True)
+    out = io.StringIO()
+    plane.write(out)
+    types, samples = _parse_exposition(out.getvalue())
+    assert types["dragonboat_tpu_engine_phase_seconds"] == "histogram"
+    engines = {lb.get("engine") for _, lb, _, _ in samples}
+    phases = {lb.get("phase") for _, lb, _, _ in samples}
+    assert engines == {"vector", "exec"}
+    assert {"pack", "save", "step"} <= phases
+    for name, _, _, keys in samples:
+        assert keys == sorted(keys), f"unsorted label keys in {name}"
+    counts = [
+        float(v) for n, lb, v, _ in samples
+        if n.endswith("_count") and lb.get("phase") == "pack"
+    ]
+    assert counts == [1.0]
+
+
+# ---------------------------------------------------------------------------
+# runtime device-sync audit
+# ---------------------------------------------------------------------------
+
+
+def test_sync_audit_attributes_out_of_seam_sites():
+    import jax.numpy as jnp
+
+    sa = sync_audit()
+    before = sa.snapshot()
+    sa.install()
+    try:
+        import jax
+
+        jax.device_get(jnp.zeros(2))  # out-of-seam: this very line
+        jax.block_until_ready(jnp.zeros(2))
+    finally:
+        sa.uninstall()
+    after = sa.snapshot()
+    d = diff_sync(before, after)
+    assert d["out_of_seam"] == 2
+    assert any("test_profile.py" in s for s in d["sites"])
+    # the test file is NOT package code: the tier-1 filter excludes it
+    own = {
+        s: n for s, n in sa.out_of_seam_in_package().items()
+        if "test_profile.py" in s
+    }
+    assert not own
+    # uninstall really restored the originals
+    import jax
+
+    assert not sa.installed
+    jax.device_get(jnp.zeros(2))
+    assert sa.snapshot()["out_of_seam"] == after["out_of_seam"]
+
+
+def test_compile_watch_attributes_retraces_per_function():
+    import jax
+    import jax.numpy as jnp
+
+    cw = compile_watch().install()
+    fn = jax.jit(lambda x: x * 2)
+    cw.register("test_fn", fn)
+    cw.register("test_fn", fn)  # idempotent: no double counting
+    mark = cw.snapshot()
+    fn(jnp.ones(3))
+    fn(jnp.ones(3))  # warm: no new trace
+    d1 = diff_compiles(mark, cw.snapshot())
+    assert d1["per_function"].get("test_fn") == 1
+    assert d1["total"] >= 1
+    fn(jnp.ones(5))  # RETRACE: new shape
+    d2 = diff_compiles(mark, cw.snapshot())
+    assert d2["per_function"].get("test_fn") == 2
+    assert d2["total"] > d1["total"]
+    # weakly held: dropping the function must release it (the watch
+    # never pins a dead engine's compiled executables) and its entry
+    # reads zero rather than a stale cache size
+    del fn
+    import gc
+
+    gc.collect()
+    assert cw.per_function().get("test_fn", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# live vector-engine scenario: the tier-1 acceptance assertions
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def vec_host(tmp_path):
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+    from tests.test_nodehost import KVSM
+
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=1,
+            rtt_millisecond=5,
+            raft_address="perf1:1",
+            nodehost_dir=str(tmp_path),
+            raft_rpc_factory=lambda l: loopback_factory(l, reg),
+            enable_metrics=True,
+            engine=EngineConfig(
+                kind="vector",
+                max_groups=8,
+                max_peers=4,
+                log_window=64,
+                profile_sample_ratio=1,  # sample EVERY step
+            ),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "perf1:1"},
+            False,
+            lambda c, n: KVSM(c, n),
+            Config(cluster_id=1, node_id=1, election_rtt=10, heartbeat_rtt=2),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            lid, ok = nh.get_leader_id(1)
+            if ok and lid == 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("no leader")
+        yield nh
+    finally:
+        nh.stop()
+
+
+@pytest.mark.perf
+def test_vector_scenario_runtime_audit_clean(vec_host):
+    """Acceptance: during a live vector-engine scenario the ONLY
+    device->host transfers are the blessed `_fetch_output` seam's, and
+    steady state compiles nothing — the runtime twins of the `-m lint`
+    device-sync/retrace gates, asserted on real behavior."""
+    nh = vec_host
+    sa = sync_audit().install()
+    cw = compile_watch().install()
+    try:
+        sess = nh.get_noop_session(1)
+        # warm: first proposals may still trigger legitimate lazy
+        # compiles (activation scatters etc.)
+        for i in range(4):
+            nh.sync_propose(sess, f"w{i}=v".encode(), timeout_s=10.0)
+        sync_mark = sa.snapshot()
+        pkg_mark = dict(sa.out_of_seam_in_package())
+        compile_mark = cw.snapshot()
+        for i in range(8):
+            nh.sync_propose(sess, f"k{i}=v".encode(), timeout_s=10.0)
+        rs = nh.read_index(1, 5.0)
+        assert rs.wait(10.0).completed
+        sync_now = sa.snapshot()
+        # the seam kept transferring (the engine stepped)...
+        assert sync_now["in_seam"] > sync_mark["in_seam"]
+        # ...and NOTHING ELSE in the package synced the device
+        new_pkg = {
+            s: n for s, n in sa.out_of_seam_in_package().items()
+            if n > pkg_mark.get(s, 0)
+        }
+        assert not new_pkg, f"out-of-seam device syncs at {new_pkg}"
+        # zero steady-state retraces, attributed per jitted function
+        d = diff_compiles(compile_mark, cw.snapshot())
+        assert d["total"] == 0, f"steady-state XLA compiles: {d}"
+        assert not d["per_function"]
+    finally:
+        sa.uninstall()
+    # the phase plane saw every vector step phase that ran
+    plane = phase_plane()
+    for phase in ("pack", "dispatch", "fetch", "place", "save", "apply"):
+        h = plane.histogram("vector", phase)
+        assert h is not None and h.count > 0, f"phase {phase} unattributed"
+    # gauges + exposition carry the audit
+    nh._export_health_gauges()
+    m = nh.metrics
+    assert m.gauge_value("engine_device_syncs_total", (0, 0)) > 0
+    assert m.gauge_value("engine_device_syncs_out_of_seam", (0, 0)) is not None
+    assert m.gauge_value("engine_compile_events_total", (0, 0)) is not None
+    out = io.StringIO()
+    nh.write_health_metrics(out)
+    text = out.getvalue()
+    assert "engine_phase_seconds_bucket" in text
+    assert 'phase="fetch"' in text
+    assert "engine_compile_cache_entries" in text
+    # registered jitted functions are named in the exposition
+    assert "step_batch[g8]" in text
+
+
+@pytest.mark.perf
+def test_bench_attribution_fold_schema():
+    """Acceptance: every bench config JSON always contains
+    phase_breakdown (ALL canonical phase keys, zero when the phase never
+    ran), device_syncs and compile_events — even on the zero-host /
+    bring-up-failed path."""
+    import bench
+
+    r = bench._attribution_report({}, None, None)
+    assert set(r["phase_breakdown"]) == set(VECTOR_PHASES)
+    assert all(v == 0.0 for v in r["phase_breakdown"].values())
+    assert r["device_syncs"] == {"in_seam": 0, "out_of_seam": 0, "sites": {}}
+    assert r["compile_events"]["total"] == 0
+    assert r["compile_events"]["per_function"] == {}
+
+
+@pytest.mark.perf
+def test_write_exposition_standalone():
+    out = io.StringIO()
+    write_exposition(out)  # whatever the process accumulated so far
+    # never raises; emits nothing or conformant families only
+    for ln in out.getvalue().splitlines():
+        assert ln.startswith("#") or "dragonboat_tpu_" in ln
+
+
+# ---------------------------------------------------------------------------
+# dump_flight artifact discipline (satellite: cap + gzip rotation) and
+# the timeline CLI's transparent .gz / --spans rendering
+# ---------------------------------------------------------------------------
+
+
+def test_dump_flight_cap_and_gzip_rotation(vec_host, tmp_path):
+    from dragonboat_tpu.tools import timeline
+
+    rec = flight_recorder()
+    for i in range(400):
+        rec.record("noise", cluster=1, seq=i, pad="x" * 64)
+    path = str(tmp_path / "dump.jsonl")
+    vec_host.dump_flight(path, max_bytes=8192)
+    assert os.path.getsize(path) <= 8192 + 512  # meta line slack
+    with open(path) as f:
+        meta = json.loads(f.readline())
+    assert meta["event"] == "_meta"
+    assert meta["dropped_events"] > 0
+    # the kept tail is the NEWEST events
+    evs = timeline.load_dump(path)
+    noise = [e for e in evs if e["event"] == "noise"]
+    assert noise and noise[-1]["seq"] == 399
+    # second dump rotates the first to a gzip artifact
+    vec_host.dump_flight(path, max_bytes=8192)
+    rotated = path + ".1.gz"
+    assert os.path.exists(rotated)
+    with gzip.open(rotated, "rt") as f:
+        assert json.loads(f.readline())["event"] == "_meta"
+    # timeline reads the rotated .gz transparently (by magic, not name)
+    evs_gz = timeline.load_dump(rotated)
+    assert any(e["event"] == "noise" for e in evs_gz)
+    # and a dump written STRAIGHT to .gz round-trips too
+    gzpath = str(tmp_path / "direct.jsonl.gz")
+    vec_host.dump_flight(gzpath)
+    assert any(e["event"] == "noise" for e in timeline.load_dump(gzpath))
+
+
+def test_timeline_spans_interleave_with_chain_stages(tmp_path, capsys):
+    from dragonboat_tpu.tools import timeline
+
+    dump = tmp_path / "spans.jsonl"
+    lines = [
+        {"event": "_meta", "mono_offset": 0.0, "source": "n1"},
+        {"event": "propose_enqueue", "t": 10.0005, "cluster": 1,
+         "node": 1, "trace": 7},
+        # recorded at span END (t=10.002) with dur 0.004 -> starts 9.998,
+        # BEFORE the propose despite the later record time
+        {"event": "phase_span", "t": 10.002, "cluster": 0,
+         "engine": "vector", "phase": "dispatch", "dur": 0.004},
+        {"event": "quorum_commit", "t": 10.003, "cluster": 1,
+         "node": 1, "trace": 7},
+        {"event": "leader_changed", "t": 10.004, "cluster": 1, "node": 1,
+         "leader": 1},
+    ]
+    dump.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+    rc = timeline.main([str(dump), "--spans"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    span_ln = [l for l in out.splitlines() if "|--" in l]
+    assert len(span_ln) == 1 and "vector/dispatch" in span_ln[0]
+    assert "4000.0us" in span_ln[0]
+    # interleaving: the span line is re-anchored to its START, so it
+    # prints before the propose; the default filter keeps chain stages
+    # and drops unrelated events
+    order = [l.split()[2] for l in out.splitlines() if l.startswith("+")]
+    assert order[0].startswith("|--") or "propose_enqueue" in out.splitlines()[1]
+    assert "leader_changed" not in out
+    assert "propose_enqueue" in out and "quorum_commit" in out

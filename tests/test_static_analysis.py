@@ -468,6 +468,74 @@ def test_trace_stamp_catches_regressions():
     ], got
 
 
+def test_profiler_stamp_guard_catches_regressions():
+    """PR 6 perf attribution plane: the profiler's stamping seams
+    (trace.Profiler.end/add, profile.PhasePlane.on_phase) are watched —
+    a phase timer whose Sample.record / histogram observe / recorder
+    span lands OUTSIDE the `if self.sampling` gate makes every step pay
+    telemetry and is a finding."""
+    got = _run(
+        """
+        class Profiler:
+            def end(self, stage):
+                s = self.samples[stage]
+                s.record(1.0)
+
+            def add(self, stage, dt):
+                self.samples[stage].record(dt)
+        """,
+        "trace.py",
+        families=("telemetry",),
+    )
+    assert _ids(got) == [
+        "telemetry/unguarded",
+        "telemetry/unguarded",
+    ], got
+    got = _run(
+        """
+        class PhasePlane:
+            def on_phase(self, engine, phase, dt, sampling):
+                h = self._hists[(engine, phase)]
+                h.observe(dt)
+                recorder.record('phase_span', engine=engine, phase=phase)
+        """,
+        "profile.py",
+        families=("telemetry",),
+    )
+    assert _ids(got) == [
+        "telemetry/unguarded",
+        "telemetry/unguarded",
+    ], got
+
+
+def test_profiler_stamp_guarded_stays_clean():
+    """The shipped shape — stamps under the sampling gate — is the
+    allowed idiom (the real trace.py/profile.py must keep passing)."""
+    got = _run(
+        """
+        class Profiler:
+            def end(self, stage):
+                if self.sampling and self._t0 is not None:
+                    self.samples[stage].record(1.0)
+        """,
+        "trace.py",
+        families=("telemetry",),
+    )
+    assert _ids(got) == [], got
+    got = _run(
+        """
+        class PhasePlane:
+            def on_phase(self, engine, phase, dt, sampling):
+                if sampling:
+                    self._hists[(engine, phase)].observe(dt)
+                    recorder.record('phase_span', engine=engine)
+        """,
+        "profile.py",
+        families=("telemetry",),
+    )
+    assert _ids(got) == [], got
+
+
 # ---------------------------------------------------------------------------
 # suppression pragmas
 # ---------------------------------------------------------------------------
