@@ -25,6 +25,7 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 VECTOR = "engine/vector.py"
 NODE = "engine/node.py"
 EXEC = "engine/execengine.py"
+NODEHOST = "nodehost.py"
 TRANSPORT = "transport/transport.py"
 LOGDB = "storage/logdb.py"
 TRACE = "trace.py"
@@ -192,6 +193,13 @@ def _default_targets() -> Targets:
             "logdb shard cache lock (state/max-index/last-batch caches)",
         ),
         LockSpec(
+            "NodeHost", "_nodes_mu", 38,
+            "node registry + launch-spec table (the restart plane: "
+            "stop/crash/restart_cluster all transition through it); held "
+            "briefly on every inbound batch and API lookup, released "
+            "before any engine or node lock is taken",
+        ),
+        LockSpec(
             "Transport", "_mu", 40,
             "transport registry lock (queue/breaker maps)",
         ),
@@ -283,6 +291,20 @@ def _default_targets() -> Targets:
                 "_pending_ticks": "_dirty_mu",
                 "_snap_status": "_snap_status_mu",
                 "_lanes": "_lanes_mu",
+                # the restart plane's lane recycling (ISSUE 7): the free
+                # list, g->lane table and message route are read by the
+                # loop/delivery hot paths and mutated by add/remove/
+                # _deactivate — a write outside _lanes_mu is exactly the
+                # double-free / stale-route class of restart bug
+                "_free": "_lanes_mu",
+                "_lane_by_g": "_lanes_mu",
+                "_route": "_lanes_mu",
+            },
+        },
+        NODEHOST: {
+            "NodeHost": {
+                "_nodes": "_nodes_mu",
+                "_launch_specs": "_nodes_mu",
             },
         },
     }
@@ -319,6 +341,7 @@ __all__ = [
     "LOGDB",
     "MANAGED",
     "NODE",
+    "NODEHOST",
     "PROFILE",
     "STATE",
     "TRACE",

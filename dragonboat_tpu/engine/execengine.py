@@ -190,6 +190,13 @@ class ExecEngine:
         with self._nodes_mu:
             return self._nodes.get(cluster_id)
 
+    def drain(self, timeout: float = 30.0) -> None:
+        """Seam parity with VectorEngine.drain(): registry removal is
+        synchronous here (remove_node pops under the lock) and a worker
+        mid-exec_nodes sees node.stopped and skips it, so the restart
+        plane has nothing to wait for."""
+        return
+
     # -------------------------------------------------------------- wakeups
     def set_node_ready(self, cluster_id: int) -> None:
         self.node_ready.notify(cluster_id)

@@ -147,6 +147,18 @@ class FairnessWatchdog:
         self._tick_bursts_clamped += 1
         flight_recorder().record("tick_burst_clamped", loop=self.name)
 
+    def reset_window(self) -> None:
+        """Forget the windowed maximum (NOT the lifetime max_gap_s).
+        Chaos harnesses call this after bring-up so the cold-compile
+        stall of the first kernel step does not sit in the 256-iteration
+        window and mask the fault-phase measurement (the restart plane's
+        graceful-degradation verdict). Cross-thread use is benign: the
+        scalars are torn-safe and the loop thread re-establishes them on
+        its next iteration."""
+        self._recent_max_s = 0.0
+        self._recent_left = self._WINDOW
+        self._last_end = self._clock()
+
     # a peer whose beat is older than this is abandoned (an engine that
     # was never stop()ed), not starved: yielding to it helps nobody and
     # a single leaked watchdog must not slow every other loop forever

@@ -1001,6 +1001,9 @@ class VectorEngine:
         self._reconq: deque = deque()  # host->device ops, loop-applied
         self._stopped = threading.Event()
         self._ready = threading.Event()
+        # crash teardown flag (stop(flush=False)): the loop discards its
+        # un-decoded in-flight step instead of landing it
+        self._discard_pending = False
         # ---- host sharing (handles) --------------------------------------
         self._hosts_mu = threading.Lock()
         self._host_refs: Set[int] = set()
@@ -1134,17 +1137,28 @@ class VectorEngine:
     # --------------------------------------------------------- registration
     def add_node(self, node: VectorNode, host: int = 0) -> None:
         key = (host, node.cluster_id)
-        with self._lanes_mu:
-            if not self._free:
-                raise RuntimeError(
-                    f"vector engine lane capacity ({self.kcfg.groups}) exhausted"
-                )
-            g = self._free.pop()
-            lane = _Lane(g, node, key=key)
-            self._lanes[key] = lane
-            self._lane_by_g[g] = lane
-            self._route[(node.cluster_id, node.node_id())] = lane
-            self._m_host[g] = host
+        lane = None
+        for attempt in range(2):
+            with self._lanes_mu:
+                if self._free:
+                    g = self._free.pop()
+                    lane = _Lane(g, node, key=key)
+                    self._lanes[key] = lane
+                    self._lane_by_g[g] = lane
+                    self._route[(node.cluster_id, node.node_id())] = lane
+                    self._m_host[g] = host
+                    break
+            if attempt == 0:
+                # the free list can be momentarily empty while freed lanes
+                # sit in the reconcile queue (stop_cluster immediately
+                # followed by restart_cluster): drain the loop once so a
+                # restart is never failed by its own predecessor's
+                # not-yet-reaped lane
+                self.drain(10.0)
+        if lane is None:
+            raise RuntimeError(
+                f"vector engine lane capacity ({self.kcfg.groups}) exhausted"
+            )
         node._vec_lane = lane
         self._reconq.append(("activate", lane))
         self.set_node_ready(key)
@@ -1318,7 +1332,14 @@ class VectorEngine:
                 traceback.print_exc()
             wd.iter_end(t0, ticks=self._last_tick_burst)
         try:
-            self._flush_pending()  # the last step's saves must land
+            if self._discard_pending:
+                # crash teardown (stop(flush=False)): the un-decoded
+                # in-flight step dies undecoded — a SIGKILL'd process
+                # would never have fanned it out or saved it, and chaos
+                # restarts must not silently grant that durability
+                self._pending = None
+            else:
+                self._flush_pending()  # the last step's saves must land
         except Exception:
             import traceback
 
@@ -2959,17 +2980,49 @@ class VectorEngine:
         return st if st is not None else State()
 
     def _deactivate(self, lane: _Lane) -> None:
+        g = lane.g
+        with self._lanes_mu:
+            if self._lane_by_g[g] is not lane:
+                # already reaped (a double remove_node, or a crash path
+                # racing a graceful stop): freeing g twice would hand the
+                # same lane index to two tenants
+                return
         s = self._state
-        self._state = s._replace(active=s.active.at[lane.g].set(False))
+        self._state = s._replace(active=s.active.at[g].set(False))
         lane.active = False
-        self._m_active[lane.g] = False
-        self._m_quiesced[lane.g] = False
+        # zero the freed lane's host planes so nothing leaks into the next
+        # tenant of g: the inbox staging rows of BOTH buffer sets (the
+        # overlap pipeline alternates sets; the next occupant must never
+        # see a stale row where _pack left data the kernel has already
+        # consumed), the pending-tick row, and every protocol mirror
+        # (lane_stats/decode gate on _m_active, but stale bases would
+        # corrupt the first reads after a mis-gated access)
+        for buf, ticks, _inbox in self._bufsets:
+            for name, plane in buf.items():
+                plane[g] = MSG.NONE if name == "mtype" else 0
+            ticks[g] = 0
+        self._m_base[g] = 0
+        self._m_devfirst[g] = 1
+        self._m_term[g] = 0
+        self._m_role[g] = ROLE.FOLLOWER
+        self._m_leader[g] = 0
+        self._m_commit[g] = 0
+        self._m_last[g] = 0
+        self._m_tick_cap[g] = 1
+        self._m_active[g] = False
+        self._m_snap_every[g] = 0
+        self._m_applied_since[g] = 0
+        self._m_snap_pending[g] = False
+        self._m_quiesced[g] = False
+        self._m_host[g] = 0
+        self._m_leader_change_tick[g] = 0
         self._carry.discard(lane)
         self._catchups.discard(lane)
         self._snapfb.discard(lane)
+        lane.node._vec_lane = None
         with self._lanes_mu:
-            self._lane_by_g[lane.g] = None
-            self._free.append(lane.g)
+            self._lane_by_g[g] = None
+            self._free.append(g)
 
     def _reconcile_membership(self, node) -> None:
         """Recompute the canonical slot mapping from the applied membership
@@ -3159,6 +3212,13 @@ class VectorEngine:
         self._m_last[g] = 0
         self._m_quiesced[g] = False
         lane.recovering = False
+        # restart/rejoin forensics: a lagging rejoiner whose log was
+        # compacted past its index MUST take this path — the longhaul
+        # runner and the restart tests assert on this event
+        flight_recorder().record(
+            "snapshot_installed", cluster=node.cluster_id,
+            node=node.node_id(), index=ss.index, term=ss.term,
+        )
         # persist the post-restore hard state and ack the leader so its
         # remote leaves the Snapshot state (raft.go handleInstallSnapshot)
         node.logdb.save_raft_state(
@@ -3309,14 +3369,21 @@ class VectorEngine:
             self._host_refs.add(host)
         return host
 
-    def release(self, host: int) -> None:
+    def release(self, host: int, flush: bool = True) -> None:
         """Detach one NodeHost handle; the core stops when the last handle
         releases (a shared core outlives any single host). The last-ref
         check and the registry removal happen under _shared_mu so a
         concurrent get_vector_engine() can never attach to a core that is
         about to stop. A non-last release drains the loop once so the
         departing host's lanes are fully deactivated before its NodeHost
-        closes the logdb under them."""
+        closes the logdb under them.
+
+        flush=False is the CRASH path (NodeHost.crash): a sole-tenant core
+        discards its un-decoded in-flight step instead of landing it — a
+        SIGKILL'd process would never have decoded or saved that output.
+        On a shared core the in-flight step belongs to the surviving
+        hosts too, so crash granularity there is the lane teardown and
+        the shared step still decodes."""
         with _shared_mu:
             with self._hosts_mu:
                 self._host_refs.discard(host)
@@ -3325,13 +3392,16 @@ class VectorEngine:
             if last:
                 _forget_shared_core_locked(self)
         if last:
-            self.stop()
+            self.stop(flush=flush)
         else:
-            self._drain()
+            self.drain()
 
-    def _drain(self, timeout: float = 30.0) -> None:
+    def drain(self, timeout: float = 30.0) -> None:
         """Block until the loop has applied every queued reconcile (incl.
-        deactivations) and finished its in-flight iteration."""
+        deactivations) and finished its in-flight iteration. The restart
+        plane's ordering barrier: after NodeHost.stop_cluster /
+        crash_cluster drain, the freed lane is on the free list and a
+        restart_cluster can reuse it immediately."""
         if self._stopped.is_set():
             return
         ev = threading.Event()
@@ -3339,11 +3409,13 @@ class VectorEngine:
         self._ready.set()
         ev.wait(timeout)
 
-    def stop(self) -> None:
+    def stop(self, flush: bool = True) -> None:
         rep = self.profiler.report()
         if rep:
             _plog.infof("vector engine stage profile:\n%s", rep)
         self.watchdog.close()
+        if not flush:
+            self._discard_pending = True
         self._stopped.set()
         self._ready.set()
         self.task_ready.wake_all()
@@ -3418,6 +3490,12 @@ class VectorEngineHandle:
 
     def stop(self) -> None:
         self.core.release(self.host)
+
+    def crash(self) -> None:
+        """SIGKILL-equivalent detach (NodeHost.crash): a sole-tenant core
+        discards its un-decoded in-flight step; a shared core keeps
+        serving its surviving hosts (see VectorEngine.release)."""
+        self.core.release(self.host, flush=False)
 
     def __getattr__(self, name):
         return getattr(self.core, name)

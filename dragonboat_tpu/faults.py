@@ -60,6 +60,9 @@ class FaultSpec:
     fsync_stall: float = 0.0  # P(sync stalls)
     fsync_stall_s: Tuple[float, float] = (0.002, 0.02)
     fsync_error: float = 0.0  # P(sync raises IOError)
+    # P(a crash_restart window also tears the victim's WAL tail before
+    # the restart) — the mid-write power-cut on top of the process death
+    tear_tail: float = 0.0
     # restrict wire faults to these types (None = all); lets a schedule
     # target e.g. replication only while heartbeats flow
     only_types: Optional[frozenset] = None
@@ -168,12 +171,19 @@ class FaultPlane:
         with self._log_mu:
             return list(self._log)
 
-    def schedule_signature(self) -> str:
+    def schedule_signature(self, sites=None) -> str:
         """Stable digest of the schedule, ORDER-INSENSITIVE across sites
         (thread interleaving between sites is not deterministic; the
-        per-site sequence is)."""
+        per-site sequence is). `sites` restricts the digest to those
+        site streams — orchestration loops use this to print a signature
+        that is bit-identical across same-seeded replays even while
+        per-message wire draws (whose COUNT depends on traffic timing)
+        ride the same plane."""
         with self._log_mu:
-            lines = sorted(repr(e) for e in self._log)
+            lines = sorted(
+                repr(e) for e in self._log
+                if sites is None or e[0] in sites
+            )
         h = hashlib.sha256()
         for ln in lines:
             h.update(ln.encode())
@@ -320,6 +330,60 @@ class FaultPlane:
             yield victim, window, idle
             budget -= window + idle
 
+    # -------------------------------------------------- crash / restart
+    def crash_restart_schedule(
+        self,
+        site: str,
+        victims,
+        total_s: float,
+        min_down_s: float = 0.1,
+        max_down_s: float = 0.5,
+        tear_tail: Optional[float] = None,
+    ):
+        """Yield a seeded sequence of (victim, down_s, idle_s, tear)
+        crash/restart windows covering ~total_s seconds — restart as a
+        first-class FaultPlane verdict (the reference's drummer/monkey
+        kill schedule, docs/test.md). The caller executes each window:
+        crash the victim (NodeHost.crash() for process-death semantics,
+        or crash_cluster() for one node of a multi-group host), wait the
+        seeded down_s restart delay — during which the surviving quorum
+        must keep serving (the graceful-degradation guarantee the
+        fairness watchdog asserts) — then restart (a fresh NodeHost on
+        the durable dir / restart_cluster) and idle idle_s. tear=True
+        directs the caller to run tear_wal_tails() on the victim's
+        closed WAL dir before the restart. All decisions ride this
+        site's single stream, so a same-seeded rerun replays the crash
+        schedule bit-identically (schedule_signature)."""
+        budget = total_s
+        victims = list(victims)
+        p_tear = self.spec.tear_tail if tear_tail is None else tear_tail
+        while budget > 0:
+            victim = self.choice(site, "crash_victim", victims)
+            down = self.uniform(site, "down_s", min_down_s, max_down_s)
+            idle = self.uniform(site, "crash_idle", 0.1, 0.4)
+            tear = self.decide(site, "tear_tail", p_tear)
+            flight_recorder().record(
+                "crash_restart_window", site=site, victim=victim,
+                down_s=round(down, 4), tear=tear, seed=self.seed,
+            )
+            yield victim, down, idle, tear
+            budget -= down + idle
+
+    def tear_wal_tails(self, logdb_dir: str, site: str) -> int:
+        """Tear the tail of every shard WAL under a CLOSED ShardedLogDB
+        root (shard-<i>/wal.log) — the disk half of a crash_restart
+        window with tear=True. Each shard tears on its own seeded
+        stream. Returns total bytes removed; recovery must roll every
+        shard back to its last sealed record group."""
+        total = 0
+        if not logdb_dir or not os.path.isdir(logdb_dir):
+            return 0
+        for name in sorted(os.listdir(logdb_dir)):
+            d = os.path.join(logdb_dir, name)
+            if name.startswith("shard-") and os.path.isdir(d):
+                total += self.tear_wal_tail(d, f"{site}/{name}")
+        return total
+
     # ----------------------------------------------------- storage faults
     def wrap_kv(self, kv: IKVStore, site: str) -> "FaultyKV":
         return FaultyKV(kv, self, site)
@@ -374,6 +438,10 @@ class FaultyKV(IKVStore):
 
     def close(self) -> None:
         self.inner.close()
+
+    def close_crashed(self) -> None:
+        cc = getattr(self.inner, "close_crashed", None)
+        (cc if cc is not None else self.inner.close)()
 
     def get_value(self, key):
         return self.inner.get_value(key)

@@ -414,6 +414,17 @@ class WalKV(IKVStore):
             finally:
                 self._f.close()
 
+    def close_crashed(self) -> None:
+        """Crash-teardown close (NodeHost.crash): release the fd WITHOUT
+        the final durability barrier — a deferred-commit batch whose
+        sync() never ran must be allowed to die exactly as a SIGKILL
+        would kill it, or chaos restarts silently grant durability the
+        real power cut never grants. FaultPlane.tear_wal_tails can then
+        chop a torn mid-write tail off the closed file."""
+        with self._mu:
+            if not self._f.closed:
+                self._f.close()
+
 
 # shared barrier pool for sync_all: fsync releases the GIL, so syncing N
 # shard WALs concurrently costs ~max(fsync) wall time instead of the sum.

@@ -287,6 +287,23 @@ class ShardedLogDB(ILogDB):
         for s in self._shards:
             s.kv.close()
 
+    def close_crashed(self) -> None:
+        """Crash-teardown close (NodeHost.crash): every shard store that
+        can skip its final durability barrier does (WalKV.close_crashed);
+        the rest close normally."""
+        for s in self._shards:
+            cc = getattr(s.kv, "close_crashed", None)
+            (cc if cc is not None else s.kv.close)()
+
+    def shard_dirs(self) -> List[str]:
+        """On-disk shard directories (empty for in-memory stores) — the
+        sweep surface for FaultPlane.tear_wal_tails after a crash."""
+        if not self._dir:
+            return []
+        return [
+            os.path.join(self._dir, f"shard-{i}") for i in range(self._num)
+        ]
+
     # -- bootstrap -----------------------------------------------------------
     def save_bootstrap_info(self, cluster_id, node_id, bootstrap) -> None:
         self._shard(cluster_id).kv.put_value(

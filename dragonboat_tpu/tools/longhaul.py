@@ -1,0 +1,767 @@
+"""Drummer-style long-haul chaos runner.
+
+The reference dragonboat earns its confidence from the drummer/monkey
+harness (docs/test.md): nodes are killed and restarted for hours against
+a live workload and correctness is asserted continuously. This module is
+that harness for the vectorized engine: a seed-rotating, wall-clock-
+bounded runner that drives a 3-host replicated KV through the FULL
+scenario mix —
+
+    crash_restart   process-death (NodeHost.crash, optionally with a
+                    torn WAL tail) or node-level crash_cluster, then a
+                    seeded-delay restart/rejoin (log replay from the
+                    leader, snapshot install when compacted past)
+    partition       full traffic partition of one host, then heal
+    drop            ~25% wire message drop window on one host
+    fsync_stall     durability-barrier stall window on every WAL
+    churn           membership churn: join a fresh node id on a 4th
+                    host, later remove it (ids never reused)
+    transfer        leadership transfer to a seeded member
+    snapshot        user snapshot request on the leader, under load
+
+— with verdicts after every round (linearizability of the recorded
+client history, replica hash + applied-index convergence, logdb Log
+Matching, and the tick-fairness watchdog's graceful-degradation check),
+a per-round seed line so ANY round replays from the log, and a forensic
+artifact bundle on failure: every live host's flight dump plus every
+`*.ring`/`*.ring.prev` crash ring swept from the run directory, merged
+into one timeline (tools.timeline) — no manual collection.
+
+Usage:
+
+    python -m dragonboat_tpu.tools.longhaul --budget 60 --seed-rotation
+    python -m dragonboat_tpu.tools.longhaul --budget 14400 --seed-rotation \
+        --round-seconds 60 --engine vector      # the nightly profile
+    CHAOS_SEED=0x2B5 python -m dragonboat_tpu.tools.longhaul \
+        --seed 0x2B5 --rounds 1                 # replay one failed round
+
+Determinism: every fault decision of a round comes from ONE FaultPlane
+seeded with the round seed, the scenario loop runs a FIXED op count
+derived from --round-seconds (not a wall-clock cut-off), and every
+orchestration draw happens unconditionally (before any runtime-state
+probe), so a replay with the same seed executes the same op sequence
+and the per-round signature — a digest of the orchestration streams
+(scenario/victim/window/crash-schedule draws; per-message wire draws,
+whose count follows traffic timing, are excluded) — matches
+bit-identically.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from ..config import Config, EngineConfig, NodeHostConfig
+from ..faults import FaultPlane, FaultSpec
+from ..lincheck import HistoryRecorder, check_kv_history
+from ..nodehost import NodeHost
+from ..requests import RequestError
+from ..statemachine import IStateMachine, Result
+from ..storage import ShardedLogDB
+from ..storage.kv import WalKV
+from ..trace import flight_recorder
+from ..transport.loopback import _Registry, loopback_factory
+from .timeline import merge_dumps, sweep_artifacts
+
+CLUSTER = 1
+HOSTS = (1, 2, 3)
+CHURN_HOST = 4  # hosts the churn scenario's joining nodes
+KEYS = tuple(f"k{i}" for i in range(4))
+
+# the signature printed per round digests ONLY these orchestration
+# streams: scenario choices, victims, windows, and crash/restart
+# schedules are drawn unconditionally, so same-seeded replays match
+# bit-identically — while per-message wire draws and per-fsync stalls
+# (whose count follows traffic timing) ride other sites and are excluded
+_ORCH_SITES = ("longhaul", "crash")
+
+SCENARIOS = (
+    "crash_restart",
+    "partition",
+    "drop",
+    "fsync_stall",
+    "churn",
+    "transfer",
+    "snapshot",
+    "none",
+)
+
+
+class _HashKV(IStateMachine):
+    """KV SM with a content hash (cf. internal/tests/kvtest.go)."""
+
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=1)
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def get_hash(self):
+        import zlib
+
+        return zlib.crc32(json.dumps(sorted(self.d.items())).encode())
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+@dataclass
+class RoundResult:
+    round_no: int
+    seed: int
+    ok: bool = False
+    ops: int = 0
+    scenarios: Dict[str, int] = field(default_factory=dict)
+    verdicts: Dict[str, bool] = field(default_factory=dict)
+    signature: str = ""
+    elapsed_s: float = 0.0
+    error: str = ""
+    bundle: str = ""
+    replay: str = ""
+
+
+@dataclass
+class Options:
+    budget_s: float = 60.0
+    rounds_max: int = 0  # 0 = unbounded (budget-gated)
+    round_s: float = 10.0
+    engine: str = "vector"
+    out_dir: str = "longhaul-out"
+    seed: Optional[int] = None
+    rotate: bool = False
+    ring: bool = False  # attach a per-round crash-persistent mmap ring
+    inject_failure: bool = False  # force a failing verdict (bundle drill)
+    scenarios: tuple = SCENARIOS
+
+
+def _round_seed(master: int, round_no: int, rotate: bool) -> int:
+    if not rotate:
+        return master
+    digest = hashlib.sha256(f"{master}:{round_no}".encode()).digest()
+    return int.from_bytes(digest[:6], "big")
+
+
+def _mk_host(
+    nid: int,
+    reg: _Registry,
+    run_dir: str,
+    engine_kind: str,
+    fp: FaultPlane,
+) -> NodeHost:
+    """One loopback NodeHost on a durable dir (h<nid> under the round
+    dir) with its shard WALs wrapped for seeded fsync-fault injection."""
+
+    def logdb_factory(d, _nid=nid):
+        return ShardedLogDB(
+            os.path.join(d, "logdb"),
+            kv_factory=fp.kv_factory(f"fsync:h{_nid}", WalKV),
+        )
+
+    cfg = NodeHostConfig(
+        deployment_id=7,
+        rtt_millisecond=5,
+        nodehost_dir=os.path.join(run_dir, f"h{nid}"),
+        raft_address=f"c{nid}:1",
+        raft_rpc_factory=lambda listen, reg=reg: loopback_factory(listen, reg),
+        logdb_factory=logdb_factory,
+        # the canonical vector shape every in-tree test uses, so the
+        # longhaul smoke shares the suite's compiled kernel (max_peers=4
+        # covers the 3 members + one churn joiner)
+        engine=EngineConfig(
+            kind=engine_kind, max_groups=32, max_peers=4, log_window=64
+        ),
+    )
+    nh = NodeHost(cfg)
+    if nid in HOSTS:
+        members = {h: f"c{h}:1" for h in HOSTS}
+        nh.start_cluster(
+            members,
+            False,
+            lambda c, n: _HashKV(),
+            Config(
+                cluster_id=CLUSTER,
+                node_id=nid,
+                election_rtt=20,
+                heartbeat_rtt=4,
+                # small thresholds so snapshot-under-load AND the
+                # compacted-past-rejoiner install path both fire inside
+                # a short round
+                snapshot_entries=60,
+                compaction_overhead=10,
+            ),
+        )
+    return nh
+
+
+def _find_leader(hosts, deadline_s=10.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for nid in HOSTS:
+            nh = hosts.get(nid)
+            if nh is None:
+                continue
+            try:
+                lid, ok = nh.get_leader_id(CLUSTER)
+            except Exception:
+                continue
+            if ok and lid == nid and not nh.is_partitioned():
+                return nid
+        time.sleep(0.02)
+    return None
+
+
+def _client_main(hosts, rec, stop, seed, client_id, seq, seq_mu):
+    import random
+
+    crng = random.Random(seed + client_id)
+    while not stop.is_set():
+        leader = _find_leader(hosts, deadline_s=3.0)
+        if leader is None:
+            continue
+        nh = hosts.get(leader)
+        if nh is None:
+            continue
+        key = crng.choice(KEYS)
+        if crng.random() < 0.7:
+            with seq_mu:
+                seq[0] += 1
+                val = f"v{seq[0]}"
+            op_id = rec.invoke(client_id, ("put", key, val))
+            try:
+                s = nh.get_noop_session(CLUSTER)
+                nh.sync_propose(s, f"{key}={val}".encode(), timeout_s=2.0)
+                rec.complete(op_id, None)
+            except Exception:
+                rec.unknown(op_id)  # indeterminate: may or may not apply
+        else:
+            op_id = rec.invoke(client_id, ("get", key))
+            try:
+                v = nh.sync_read(CLUSTER, key, timeout_s=2.0)
+                rec.complete(op_id, v)
+            except Exception:
+                rec.fail(op_id)  # reads have no side effect
+        time.sleep(crng.random() * 0.01)
+
+
+class _Round:
+    """One seeded round: 3 hosts + churn host, client traffic, a fixed
+    count of seeded scenario ops, then settle + verdicts + artifacts."""
+
+    def __init__(self, round_no: int, seed: int, opts: Options) -> None:
+        self.no = round_no
+        self.seed = seed
+        self.opts = opts
+        self.dir = os.path.join(
+            opts.out_dir, f"round-{round_no:03d}-seed-0x{seed:X}"
+        )
+        os.makedirs(self.dir, exist_ok=True)
+        self.fp = FaultPlane(
+            seed, FaultSpec(drop=0.25, tear_tail=0.5)
+        )
+        self.reg = _Registry()
+        self.hosts: Dict[int, Optional[NodeHost]] = {}
+        self.result = RoundResult(round_no=round_no, seed=seed)
+        self.churn_ids: List[int] = []  # joined-and-not-yet-removed ids
+        self._next_churn_id = CHURN_HOST
+        self._crash_gen = None
+
+    # ------------------------------------------------------------ lifecycle
+    def run(self) -> RoundResult:
+        t0 = time.monotonic()
+        res = self.result
+        if self.opts.ring:
+            try:
+                flight_recorder().attach_mmap(
+                    os.path.join(self.dir, "flight.ring")
+                )
+            except Exception:
+                pass  # forensics must never block the run
+        rec = HistoryRecorder()
+        stop = threading.Event()
+        try:
+            for nid in HOSTS + (CHURN_HOST,):
+                self.hosts[nid] = _mk_host(
+                    nid, self.reg, self.dir, self.opts.engine, self.fp
+                )
+            # warmup barrier: bring-up (incl. the cold kernel compile on
+            # the vector step loop) is not part of the measured fault
+            # phase — wait for a leader, then zero the fairness windows
+            # so the graceful-degradation verdict sees only the chaos
+            _find_leader(self.hosts, deadline_s=30.0)
+            for nh in self.hosts.values():
+                wd = getattr(nh.engine, "watchdog", None)
+                if wd is not None:
+                    wd.reset_window()
+            seq, seq_mu = [0], threading.Lock()
+            clients = [
+                threading.Thread(
+                    target=_client_main,
+                    args=(self.hosts, rec, stop, self.seed, i, seq, seq_mu),
+                    daemon=True,
+                )
+                for i in range(3)
+            ]
+            for t in clients:
+                t.start()
+            self._scenario_loop()
+            stop.set()
+            for t in clients:
+                t.join(timeout=5)
+            self._settle()
+            self._verify(rec)
+        except Exception as e:
+            stop.set()
+            res.error = f"{type(e).__name__}: {e}"
+            res.verdicts["no_exception"] = False
+        finally:
+            res.signature = self.fp.schedule_signature(
+                sites=_ORCH_SITES
+            )[:16]
+            if self.opts.inject_failure:
+                res.verdicts["injected_failure"] = False
+            res.ok = bool(res.verdicts) and all(res.verdicts.values())
+            res.ops = len(rec.history())
+            if not res.ok:
+                try:
+                    self._bundle_failure()
+                except Exception as e:  # bundling must not mask the verdict
+                    res.bundle = f"(bundle failed: {e})"
+            for nh in self.hosts.values():
+                if nh is not None:
+                    try:
+                        nh.stop()
+                    except Exception:
+                        pass
+            res.elapsed_s = time.monotonic() - t0
+        return res
+
+    # -------------------------------------------------------- scenario ops
+    def _scenario_loop(self) -> None:
+        # FIXED op count (not a wall-clock cut-off): a same-seeded replay
+        # executes the same op sequence, so the schedule signature matches
+        fp = self.fp
+        n_ops = max(3, int(self.opts.round_s / 1.2))
+        for _ in range(n_ops):
+            sc = fp.choice("longhaul", "scenario", list(self.opts.scenarios))
+            self.result.scenarios[sc] = self.result.scenarios.get(sc, 0) + 1
+            try:
+                getattr(self, f"_op_{sc}")()
+            except RequestError:
+                pass  # no leader / timeout during faults: part of the game
+            except Exception as e:
+                # orchestration must survive any single op (a failure
+                # here surfaces in the verdicts, not as a runner crash)
+                flight_recorder().record(
+                    "longhaul_op_error", op=sc, err=f"{type(e).__name__}: {e}",
+                )
+
+    def _op_none(self) -> None:
+        time.sleep(0.3)
+
+    def _op_crash_restart(self) -> None:
+        if self._crash_gen is None:
+            self._crash_gen = self.fp.crash_restart_schedule(
+                "crash", list(HOSTS), total_s=1e9,
+                min_down_s=0.15, max_down_s=0.6,
+            )
+        victim, down, idle, tear = next(self._crash_gen)
+        kind = self.fp.choice("crash", "kind", ["host", "node"])
+        nh = self.hosts.get(victim)
+        if nh is None:
+            return
+        if kind == "node":
+            # node-level: the host survives, one raft node dies and rejoins
+            try:
+                nh.crash_cluster(CLUSTER)
+            except RequestError:
+                return
+            time.sleep(down)
+            nh2 = self.hosts.get(victim)
+            if nh2 is not None:
+                nh2.restart_cluster(CLUSTER)
+        else:
+            # host-level: SIGKILL-equivalent process death, optional torn
+            # WAL tail, restart from the durable dir
+            ldir = nh.logdb_dir()
+            self.hosts[victim] = None
+            nh.crash()
+            if tear:
+                self.fp.tear_wal_tails(ldir, f"tear:h{victim}")
+            time.sleep(down)
+            self.hosts[victim] = _mk_host(
+                victim, self.reg, self.dir, self.opts.engine, self.fp
+            )
+        time.sleep(idle)
+
+    def _op_partition(self) -> None:
+        fp = self.fp
+        victim = fp.choice("longhaul", "victim", list(HOSTS))
+        nh = self.hosts.get(victim)
+        if nh is None:
+            return
+        nh.set_partitioned(True)
+        time.sleep(fp.uniform("longhaul", "window", 0.3, 0.8))
+        nh2 = self.hosts.get(victim)
+        if nh2 is not None:
+            nh2.set_partitioned(False)
+
+    def _op_drop(self) -> None:
+        fp = self.fp
+        victim = fp.choice("longhaul", "victim", list(HOSTS))
+        nh = self.hosts.get(victim)
+        if nh is None:
+            return
+        fp.install(nh, f"h{victim}")
+        time.sleep(fp.uniform("longhaul", "window", 0.3, 0.8))
+        nh2 = self.hosts.get(victim)
+        if nh2 is not None:
+            fp.uninstall(nh2)
+
+    def _op_fsync_stall(self) -> None:
+        fp = self.fp
+        base = fp.spec
+        fp.set_spec(replace(base, fsync_stall=0.25))
+        try:
+            time.sleep(fp.uniform("longhaul", "window", 0.3, 0.8))
+        finally:
+            fp.set_spec(base)
+
+    def _op_transfer(self) -> None:
+        # draw BEFORE probing runtime state: every op consumes the same
+        # stream prefix on a same-seeded replay even when the op is then
+        # skipped, so the schedule signature matches bit-identically
+        target = self.fp.choice("longhaul", "transfer_to", list(HOSTS))
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        if leader is None:
+            return
+        nh = self.hosts.get(leader)
+        if nh is not None and target != leader:
+            nh.request_leader_transfer(CLUSTER, target)
+            time.sleep(0.2)
+
+    def _op_snapshot(self) -> None:
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        if leader is None:
+            return
+        nh = self.hosts.get(leader)
+        if nh is not None:
+            nh.request_snapshot(CLUSTER, timeout_s=5.0)
+            time.sleep(0.1)
+
+    def _op_churn(self) -> None:
+        """Membership churn: join a FRESH node id on the churn host, or
+        remove the oldest joined one (removed ids are never reused —
+        the reference forbids a removed node rejoining)."""
+        # draw BEFORE probing runtime state (replay determinism, see
+        # _op_transfer)
+        rm = self.fp.decide("longhaul", "churn_rm", 0.5)
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        churn_nh = self.hosts.get(CHURN_HOST)
+        if leader is None or churn_nh is None:
+            return
+        lnh = self.hosts.get(leader)
+        if lnh is None:
+            return
+        if self.churn_ids and rm:
+            # pop only AFTER the delete commits: a timed-out delete must
+            # keep the member tracked, or _settle never sheds it and the
+            # next join strands a committed member that never runs
+            nid = self.churn_ids[0]
+            lnh.sync_request_delete_node(CLUSTER, nid, timeout_s=5.0)
+            self.churn_ids.pop(0)
+            try:
+                churn_nh.stop_cluster(CLUSTER)
+            except RequestError:
+                pass
+        elif not self.churn_ids:  # churn host serves one joiner at a time
+            nid = self._next_churn_id
+            self._next_churn_id += 1
+            lnh.sync_request_add_node(
+                CLUSTER, nid, f"c{CHURN_HOST}:1", timeout_s=5.0
+            )
+            # track the id the moment the membership change commits:
+            # even if start_cluster below fails, _settle must still shed
+            # the committed member
+            self.churn_ids.append(nid)
+            churn_nh.start_cluster(
+                {},
+                True,
+                lambda c, n: _HashKV(),
+                Config(
+                    cluster_id=CLUSTER, node_id=nid,
+                    election_rtt=20, heartbeat_rtt=4,
+                    snapshot_entries=60, compaction_overhead=10,
+                ),
+            )
+
+    # ------------------------------------------------------------- verdicts
+    def _settle(self) -> None:
+        """Heal every fault, restart every down host/node, and shed the
+        churn member so the 3-way convergence checks see a clean group."""
+        self.fp.uninstall_all()
+        for nid in HOSTS:
+            if self.hosts.get(nid) is None:
+                self.hosts[nid] = _mk_host(
+                    nid, self.reg, self.dir, self.opts.engine, self.fp
+                )
+            nh = self.hosts[nid]
+            nh.set_partitioned(False)
+            nh.transport.set_pre_send_batch_hook(None)
+            if not nh.has_node(CLUSTER):
+                nh.restart_cluster(CLUSTER)
+        # remove any still-joined churn member (best effort with retries:
+        # leadership can still be settling right after the fault phase)
+        deadline = time.monotonic() + 30
+        while self.churn_ids and time.monotonic() < deadline:
+            leader = _find_leader(self.hosts, deadline_s=10.0)
+            if leader is None:
+                continue
+            try:
+                nid = self.churn_ids[0]
+                try:
+                    self.hosts[leader].sync_request_delete_node(
+                        CLUSTER, nid, timeout_s=5.0
+                    )
+                except RequestError:
+                    # a delete that timed out in the fault phase may have
+                    # committed already: rejected/failed retries of an
+                    # already-removed member count as shed
+                    m = self.hosts[leader].get_cluster_membership(CLUSTER)
+                    if nid in m.addresses:
+                        raise
+                self.churn_ids.pop(0)
+                churn_nh = self.hosts.get(CHURN_HOST)
+                if churn_nh is not None and churn_nh.has_node(CLUSTER):
+                    churn_nh.stop_cluster(CLUSTER)
+            except Exception:
+                time.sleep(0.2)
+
+    def _verify(self, rec: HistoryRecorder) -> None:
+        v = self.result.verdicts
+        hosts = self.hosts
+        # one final write forces commit-index convergence
+        deadline = time.monotonic() + 45
+        final_ok = False
+        while time.monotonic() < deadline and not final_ok:
+            leader = _find_leader(hosts, deadline_s=20.0)
+            if leader is None:
+                break
+            try:
+                s = hosts[leader].get_noop_session(CLUSTER)
+                hosts[leader].sync_propose(s, b"final=done", timeout_s=5.0)
+                final_ok = True
+            except Exception:
+                time.sleep(0.2)
+        v["recovered_leader"] = final_ok
+        idx: Dict[int, int] = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                idx = {
+                    nid: hosts[nid].get_applied_index(CLUSTER)
+                    for nid in HOSTS
+                }
+            except Exception:
+                time.sleep(0.1)
+                continue
+            if len(set(idx.values())) == 1:
+                break
+            time.sleep(0.05)
+        v["applied_converged"] = len(set(idx.values())) == 1 and bool(idx)
+        try:
+            hashes = {hosts[n].get_sm_hash(CLUSTER) for n in HOSTS}
+            v["hashes_converged"] = len(hashes) == 1
+        except Exception:
+            v["hashes_converged"] = False
+        # persisted logs obey Log Matching below the common commit point
+        try:
+            from .logdbcheck import check_logdb_consistency
+
+            report = check_logdb_consistency(
+                {nid: hosts[nid].logdb for nid in HOSTS}, CLUSTER
+            )
+            v["logdb_consistent"] = report.ok
+        except Exception:
+            v["logdb_consistent"] = False
+        history = rec.history()
+        v["lincheck"] = check_kv_history(history, max_states=5_000_000)
+        # graceful degradation (watchdog-asserted): no surviving host's
+        # engine loop may have stalled while peers crashed or caught up
+        worst_gap = 0.0
+        for nid in HOSTS:
+            stats = getattr(hosts[nid].engine, "fairness_stats", None)
+            if stats is not None:
+                worst_gap = max(worst_gap, stats()["recent_max_gap_s"])
+        v["fairness_no_stall"] = worst_gap < 5.0
+
+    # ------------------------------------------------------------ artifacts
+    def _bundle_failure(self) -> None:
+        """Assemble the forensic bundle: live hosts' flight dumps + every
+        ring/dump artifact swept from the round dir, merged into one
+        timeline, plus a manifest with the one-line replay command."""
+        bundle = os.path.join(self.dir, "failure_bundle")
+        os.makedirs(bundle, exist_ok=True)
+        # ONE process-level dump: this harness is in-process, so every
+        # host shares the process-global recorder (a real multi-process
+        # deployment drops one dump per host into the run dir instead —
+        # the sweep merges either layout)
+        for nh in self.hosts.values():
+            if nh is not None:
+                try:
+                    nh.dump_flight(os.path.join(bundle, "flight_dump.jsonl"))
+                except Exception:
+                    continue
+                break
+        swept = sweep_artifacts(self.dir)
+        merged = merge_dumps(swept)
+        merged_path = os.path.join(bundle, "merged_timeline.jsonl")
+        with open(merged_path, "w") as f:
+            for e in merged:
+                f.write(json.dumps(e, default=str, sort_keys=True) + "\n")
+        self.result.replay = self._replay_cmd()
+        manifest = {
+            "round": self.no,
+            "seed": f"0x{self.seed:X}",
+            "engine": self.opts.engine,
+            "verdicts": self.result.verdicts,
+            "error": self.result.error,
+            "scenarios": self.result.scenarios,
+            "schedule_signature": self.fp.schedule_signature(
+                sites=_ORCH_SITES
+            ),
+            "swept_artifacts": swept,
+            "merged_events": len(merged),
+            "replay": self.result.replay,
+        }
+        with open(os.path.join(bundle, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        self.result.bundle = bundle
+
+    def _replay_cmd(self) -> str:
+        return (
+            f"CHAOS_SEED=0x{self.seed:X} python -m "
+            f"dragonboat_tpu.tools.longhaul --seed 0x{self.seed:X} "
+            f"--rounds 1 --round-seconds {self.opts.round_s:g} "
+            f"--engine {self.opts.engine}"
+        )
+
+
+def run_longhaul(opts: Options) -> dict:
+    """Run rounds until the wall-clock budget (or --rounds cap) is spent;
+    returns {rounds: [RoundResult...], ok, ...}. Each round prints one
+    summary line; failures print the bundle path + replay command."""
+    os.makedirs(opts.out_dir, exist_ok=True)
+    master = (
+        opts.seed
+        if opts.seed is not None
+        else int(os.environ.get("CHAOS_SEED", "0") or "0", 0)
+        or int.from_bytes(os.urandom(6), "big")
+    )
+    t_end = time.monotonic() + opts.budget_s
+    results: List[RoundResult] = []
+    round_no = 0
+    print(
+        f"[longhaul] budget={opts.budget_s:g}s master-seed=0x{master:X} "
+        f"rotation={'on' if opts.rotate else 'off'} engine={opts.engine} "
+        f"out={opts.out_dir}",
+        flush=True,
+    )
+    while time.monotonic() < t_end:
+        if opts.rounds_max and round_no >= opts.rounds_max:
+            break
+        round_no += 1
+        seed = _round_seed(master, round_no, opts.rotate)
+        res = _Round(round_no, seed, opts).run()
+        results.append(res)
+        sc = ",".join(f"{k}:{n}" for k, n in sorted(res.scenarios.items()))
+        print(
+            f"[longhaul] round {res.round_no} seed=0x{res.seed:X} "
+            f"scenarios={sc or '-'} ops={res.ops} sig={res.signature} "
+            f"verdict={'OK' if res.ok else 'FAIL'} {res.elapsed_s:.1f}s",
+            flush=True,
+        )
+        if not res.ok:
+            bad = sorted(k for k, val in res.verdicts.items() if not val)
+            print(
+                f"[longhaul] round {res.round_no} FAILED "
+                f"verdicts={bad} error={res.error or '-'} "
+                f"bundle={res.bundle or '-'}",
+                flush=True,
+            )
+            if res.replay:
+                print(f"[longhaul] replay: {res.replay}", flush=True)
+    ok = bool(results) and all(r.ok for r in results)
+    print(
+        f"[longhaul] done: {len(results)} round(s), "
+        f"{sum(1 for r in results if not r.ok)} failure(s)",
+        flush=True,
+    )
+    return {
+        "ok": ok,
+        "master_seed": master,
+        "rounds": results,
+        "budget_s": opts.budget_s,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dragonboat_tpu.tools.longhaul",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--budget", type=float, default=60.0,
+                    help="wall-clock budget in seconds (default 60; the "
+                         "nightly profile passes hours)")
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="hard cap on rounds (0 = budget-gated)")
+    ap.add_argument("--round-seconds", type=float, default=10.0,
+                    help="scenario-phase length per round (drives the "
+                         "fixed op count; settle/verify time is extra)")
+    ap.add_argument("--seed", type=lambda v: int(v, 0), default=None,
+                    help="master seed (hex ok; default CHAOS_SEED env or "
+                         "random)")
+    ap.add_argument("--seed-rotation", action="store_true",
+                    help="derive a fresh seed per round from the master "
+                         "(the long-haul mode); off = every round replays "
+                         "the master seed")
+    ap.add_argument("--engine", choices=("vector", "scalar"),
+                    default="vector")
+    ap.add_argument("--out", default="longhaul-out",
+                    help="run directory (round dirs + failure bundles)")
+    ap.add_argument("--no-ring", action="store_true",
+                    help="skip the per-round crash-persistent mmap ring")
+    ap.add_argument("--inject-failure", action="store_true",
+                    help="force a failing verdict each round (drills the "
+                         "artifact bundle + replay-command path)")
+    args = ap.parse_args(argv)
+    report = run_longhaul(
+        Options(
+            budget_s=args.budget,
+            rounds_max=args.rounds,
+            round_s=args.round_seconds,
+            engine=args.engine,
+            out_dir=args.out,
+            seed=args.seed,
+            rotate=args.seed_rotation,
+            ring=not args.no_ring,
+            inject_failure=args.inject_failure,
+        )
+    )
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

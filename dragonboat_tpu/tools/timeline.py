@@ -115,6 +115,27 @@ def merge_dumps(paths) -> List[dict]:
     return events
 
 
+# artifact suffixes sweep_artifacts collects: JSONL flight dumps (plain
+# and gzip-rotated) and crash-persistent mmap rings incl. the `.prev`
+# rotation a restarted process leaves behind (trace.attach_mmap)
+_SWEEP_SUFFIXES = (".ring", ".ring.prev", ".jsonl", ".jsonl.gz")
+
+
+def sweep_artifacts(root: str) -> List[str]:
+    """Walk a run directory (e.g. a tools.longhaul round dir) for every
+    forensic artifact a chaos run can leave behind — JSONL flight dumps
+    and `*.ring` / `*.ring.prev` mmap rings from crashed or restarted
+    processes — so a failure bundle never requires manual collection.
+    Returns sorted paths; non-ring `.ring` files (torn/empty) are kept —
+    load_dump skips what it cannot parse."""
+    out: List[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if fn.endswith(_SWEEP_SUFFIXES):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
 def filter_events(
     events: List[dict],
     cluster: Optional[int] = None,
@@ -240,7 +261,12 @@ def main(argv=None) -> int:
         prog="python -m dragonboat_tpu.tools.timeline",
         description=__doc__.splitlines()[0],
     )
-    ap.add_argument("paths", nargs="+", help="JSONL dumps and/or mmap rings")
+    ap.add_argument("paths", nargs="*", help="JSONL dumps and/or mmap rings")
+    ap.add_argument("--sweep", action="append", default=None,
+                    metavar="DIR",
+                    help="walk DIR for *.jsonl/*.jsonl.gz/*.ring/"
+                         "*.ring.prev artifacts and merge them all "
+                         "(repeatable; composes with explicit paths)")
     ap.add_argument("--cluster", type=_parse_int, default=None,
                     help="only events of this raft group (0 = host-level)")
     ap.add_argument("--trace", type=_parse_int, default=None,
@@ -256,6 +282,12 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the merged, filtered events as JSONL")
     args = ap.parse_args(argv)
+    paths = list(args.paths)
+    for d in args.sweep or ():
+        paths.extend(sweep_artifacts(d))
+    if not paths:
+        ap.error("no artifacts: give paths and/or --sweep DIR")
+    args.paths = paths
     kinds = set(args.event) if args.event else None
     if args.spans and kinds is None:
         # default --spans view: the profiler spans against the causal

@@ -44,6 +44,14 @@ def pytest_configure(config):
         "vector-engine scenario; run it alone with `-m perf` alongside "
         "the `-m lint` gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "longhaul: the drummer-style long-haul runner's bounded smoke "
+        "profile (tools.longhaul with a tight --budget, <60s) — tier-1 "
+        "proves the runner end to end (rounds, verdicts, failure "
+        "bundles); the hours-long profile stays opt-in via "
+        "`python -m dragonboat_tpu.tools.longhaul --budget <secs>`",
+    )
 
 
 # ---- hang diagnosis (the Python half of the race-detection story; see

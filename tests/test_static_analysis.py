@@ -661,3 +661,80 @@ def test_families_cover_issue_contract():
         "device-sync",
         "retrace",
     }
+
+
+# ---------------------------------------------------------------------------
+# meta-tests: restart-plane targets (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_plane_locks_are_declared():
+    """The restart plane's shared state is covered by the lock config:
+    NodeHost._nodes_mu ranks OUTSIDE every engine/node lock (stop/crash/
+    restart take it first, then talk to the engine), and the engine's
+    lane free list / g->lane table / route are declared _lanes_mu-guarded."""
+    nh = DEFAULT_TARGETS.lock_rank("NodeHost", "_nodes_mu")
+    assert nh is not None, "NodeHost._nodes_mu missing from the hierarchy"
+    node_mu = DEFAULT_TARGETS.lock_rank("Node", "_mu")
+    lanes_mu = DEFAULT_TARGETS.lock_rank("VectorEngine", "_lanes_mu")
+    assert nh.rank < node_mu.rank < lanes_mu.rank
+    g = DEFAULT_TARGETS.guarded_state
+    assert g["nodehost.py"]["NodeHost"]["_launch_specs"] == "_nodes_mu"
+    assert g["nodehost.py"]["NodeHost"]["_nodes"] == "_nodes_mu"
+    for fld in ("_free", "_lane_by_g", "_route"):
+        assert g["engine/vector.py"]["VectorEngine"][fld] == "_lanes_mu"
+
+
+def test_restart_plane_guarded_state_catches_unlocked_free_list():
+    """A lane free-list (or route/launch-spec) mutation outside its lock
+    is exactly the double-free / stale-route restart bug class; the
+    seeded violations must flag and the locked idiom must stay clean."""
+    got = _run(
+        """
+        class VectorEngine:
+            def remove_node(self, key):
+                self._free.append(key)
+                self._route[key] = None
+                with self._lanes_mu:
+                    self._free.append(key)
+        """,
+        "engine/vector.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/guarded-state", "locks/guarded-state"], got
+    got = _run(
+        """
+        class NodeHost:
+            def restart_cluster(self, cid):
+                self._launch_specs[cid] = ()
+            def _detach_cluster(self, cid):
+                with self._nodes_mu:
+                    self._nodes.pop(cid, None)
+        """,
+        "nodehost.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/guarded-state"], got
+
+
+def test_restart_plane_lock_order_nodes_mu_before_node_mu():
+    """Restart-vs-step-loop ordering: _nodes_mu is declared OUTER, so
+    taking it while holding a node's protocol lock (the inversion a
+    restart path deadlocking against the step loop would need) flags."""
+    got = _run(
+        """
+        class NodeHost:
+            def bad(self, node):
+                with node._mu:
+                    with self._nodes_mu:
+                        pass
+            def good(self, node):
+                with self._nodes_mu:
+                    pass
+                with node._mu:
+                    pass
+        """,
+        "nodehost.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/order"], got
