@@ -481,6 +481,7 @@ def _bench_e2e_body(
     out.update(_attribution_report(hosts, sync_mark, compile_mark))
     out.update(_latency_report(hosts))
     out.update(_lane_report(hosts))
+    out.update(_serving_report(hosts))
     return out
 
 
@@ -557,6 +558,41 @@ def _lane_report(hosts) -> dict:
         "lanes_with_leader": lanes_with_leader,
         "lane_commit_gap_max": gap_max,
         "lane_commit_gap_p50": gaps[len(gaps) // 2] if gaps else 0,
+    }
+
+
+def _serving_report(hosts) -> dict:
+    """Serving-front overload fold (ISSUE 8): total admit/shed/wake
+    counts across every tenant of every host that created a front, and
+    the urgent/bulk serving latency percentiles merged across hosts from
+    the (tenant, klass)-keyed histogram plane. Keys are ALWAYS present —
+    zero when no front exists (the default harness drives propose_batch
+    directly) — so the BENCH JSON schema is stable across configs."""
+    from dragonboat_tpu.events import Histogram
+    from dragonboat_tpu.serving import KLASS_BULK, KLASS_URGENT
+
+    admitted = shed = wakes = 0
+    lat = {KLASS_URGENT: Histogram(), KLASS_BULK: Histogram()}
+    for nh in hosts.values():
+        front = getattr(nh, "_serving", None)
+        if front is not None:
+            for c in front.admission.counters().values():
+                admitted += sum(c["admitted"].values())
+                shed += sum(c["shed"].values())
+                wakes += c["wakes"]
+        m = getattr(nh, "metrics", None)
+        if m is None:
+            continue
+        for (_tid, klass), h in m.histogram_items("serving_latency_seconds"):
+            if klass in lat:
+                lat[klass].merge(h)
+    return {
+        "serving_admitted_total": admitted,
+        "serving_shed_total": shed,
+        "serving_wakes_total": wakes,
+        "serving_urgent_p99_s": round(lat[KLASS_URGENT].quantile(0.99), 6),
+        "serving_bulk_p50_s": round(lat[KLASS_BULK].quantile(0.5), 6),
+        "serving_bulk_p99_s": round(lat[KLASS_BULK].quantile(0.99), 6),
     }
 
 

@@ -28,11 +28,15 @@ EXEC = "engine/execengine.py"
 NODEHOST = "nodehost.py"
 TRANSPORT = "transport/transport.py"
 LOGDB = "storage/logdb.py"
+KV = "storage/kv.py"
 TRACE = "trace.py"
 PROFILE = "profile.py"
 MANAGED = "rsm/managed.py"
 KERNEL = "ops/kernel.py"
 STATE = "ops/state.py"
+SERVING_ADMISSION = "serving/admission.py"
+SERVING_BACKPRESSURE = "serving/backpressure.py"
+SERVING_FRONT = "serving/front.py"
 
 FnKey = Tuple[str, str]  # (relpath, qualname)
 
@@ -220,11 +224,35 @@ def _default_targets() -> Targets:
             "engine snapshot-completion set",
         ),
         LockSpec(
+            "ServingFront", "_mu", 45,
+            "serving-front tenant queue table (admitted-but-unsubmitted "
+            "bulk ops); released before propose_batch is called, never "
+            "held across engine or node locks",
+        ),
+        LockSpec(
+            "AdmissionController", "_mu", 46,
+            "admission tenant registry + admit/shed ledger",
+        ),
+        LockSpec(
+            "SaturationMonitor", "_mu", 47,
+            "cached saturation score + last signal sample",
+        ),
+        LockSpec(
             "_SendQueue", "_cv", 50,
             "send-queue condition (urgent/bulk deques + admission counters)",
         ),
         LockSpec(
             "_Breaker", "_mu", 50, "circuit-breaker state",
+        ),
+        LockSpec(
+            "TokenBucket", "_mu", 55,
+            "token-bucket balance/refill-time pair (leaf: one take() is "
+            "one atomic refill+spend)",
+        ),
+        LockSpec(
+            "_BarrierStats", "_mu", 60,
+            "WAL barrier-pressure gauge (leaf: taken inside the fsync "
+            "seam with shard locks already held)",
         ),
         LockSpec(
             "MmapRing", "_mu", 60,
@@ -307,6 +335,33 @@ def _default_targets() -> Targets:
                 "_launch_specs": "_nodes_mu",
             },
         },
+        # the serving overload plane (ISSUE 8): admit/shed decisions and
+        # the saturation cache are read on every client request from many
+        # threads — a write outside the declared lock is exactly the
+        # lost-increment / torn-decision class of admission bug
+        KV: {
+            "_BarrierStats": {
+                "ewma_s": "_mu",
+                "last_s": "_mu",
+                "last_wave_s": "_mu",
+                "inflight": "_mu",
+                "barriers": "_mu",
+            },
+        },
+        SERVING_ADMISSION: {
+            "AdmissionController": {"_tenants": "_mu"},
+            "TokenBucket": {"tokens": "_mu", "_t": "_mu"},
+        },
+        SERVING_BACKPRESSURE: {
+            "SaturationMonitor": {
+                "_cached": "_mu",
+                "_cached_at": "_mu",
+                "_last_signals": "_mu",
+            },
+        },
+        SERVING_FRONT: {
+            "ServingFront": {"_queues": "_mu"},
+        },
     }
     return Targets(
         hot_functions=hot,
@@ -338,11 +393,15 @@ __all__ = [
     "LockSpec",
     "Targets",
     "KERNEL",
+    "KV",
     "LOGDB",
     "MANAGED",
     "NODE",
     "NODEHOST",
     "PROFILE",
+    "SERVING_ADMISSION",
+    "SERVING_BACKPRESSURE",
+    "SERVING_FRONT",
     "STATE",
     "TRACE",
     "TRANSPORT",

@@ -666,16 +666,25 @@ class Raft:
         # Scan the committed-but-unapplied window for config changes. The
         # reference conservatively refuses to campaign whenever
         # committed > applied and notes the precise scan as a TODO
-        # (raft.go:1461-1470); with entries held in memory the scan is cheap.
+        # (raft.go:1461-1470); with entries held in memory the scan is
+        # cheap. When the scan CANNOT see part of the window (storage
+        # truncated a batch to nothing under max_entry_size, or the
+        # window raced a compaction), fall back to the reference's
+        # conservative answer — an unseen entry might be a config change,
+        # and refusing one campaign beats campaigning across a quorum
+        # change that hasn't applied yet.
         if self.log.committed <= self.applied:
             return False
         idx = max(self.applied + 1, self.log.first_index())
         while idx <= self.log.committed:
-            ents = self.log.get_entries(
-                idx, self.log.committed + 1, settings.soft.max_entry_size
-            )
+            try:
+                ents = self.log.get_entries(
+                    idx, self.log.committed + 1, settings.soft.max_entry_size
+                )
+            except ErrCompacted:
+                return True
             if not ents:
-                return False
+                return True
             if any(e.is_config_change() for e in ents):
                 return True
             idx = ents[-1].index + 1

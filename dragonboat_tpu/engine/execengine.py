@@ -331,6 +331,23 @@ class ExecEngine:
         """Tick-fairness watchdog snapshot (see engine/fairness.py)."""
         return self.watchdog.stats()
 
+    def pressure_stats(self) -> dict:
+        """Serving-front backpressure probe, shape-compatible with
+        VectorEngine.pressure_stats(): worst incoming-queue fill across
+        this engine's groups (the EntryQueue/ReadIndexQueue whose
+        overflow IS the ErrSystemBusy raise site one add() later). The
+        scalar engine has no staged-row plane, so backlog is always 0."""
+        occ = 0.0
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            occ = max(
+                occ,
+                node.incoming_proposals.fill(),
+                node.incoming_reads.fill(),
+            )
+        return {"inbox_occupancy": occ, "staged_backlog": 0}
+
     def lane_stats(self) -> Dict[int, dict]:
         """Per-group introspection, shape-compatible with
         VectorEngine.lane_stats(): cluster_id -> {node_id, leader_id,

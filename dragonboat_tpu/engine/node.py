@@ -465,6 +465,19 @@ class Node:
         for h in handles:
             h.expire()
 
+    def notify_admission(self) -> bool:
+        """Serving-front first-admit wake (engine/quiesce.py contract):
+        an idle quiesced group resumes ticking immediately instead of
+        waiting for the admitted op to reach the step loop. Returns True
+        when the group was actually quiesced. Called from API threads;
+        the quiesce fields are GIL-atomic scalars and a racing step-side
+        tick at worst re-enters quiesce one threshold later — the same
+        tolerance record_activity already has."""
+        woke = self.quiesce_mgr.wake_on_admit()
+        if woke:
+            self.engine.set_node_ready(self.cluster_id)
+        return woke
+
     def read(self, timeout_ticks: int) -> RequestState:
         rs = self.pending_read_indexes.read(timeout_ticks)
         s = self._req_sampler

@@ -43,6 +43,15 @@ class EntryQueue:
         enqueueing, so the next iteration drains what this one missed."""
         return bool(self._left or self._right)
 
+    def fill(self) -> float:
+        """Lock-free fill fraction in [0, 1] — the backpressure probe the
+        serving front's SaturationMonitor polls (a full queue here is the
+        ErrSystemBusy raise site one add() later). Torn reads under
+        concurrent swaps cost at most one stale sample."""
+        return min(
+            (len(self._left) + len(self._right)) / self._size, 1.0
+        )
+
     def add_many(self, entries: List[Entry]) -> int:
         """Enqueue a batch under ONE lock acquisition; returns how many
         were accepted (the tail past capacity is refused and the queue
@@ -104,6 +113,10 @@ class ReadIndexQueue:
 
     def has_pending(self) -> bool:
         return bool(self._pending)
+
+    def fill(self) -> float:
+        """Lock-free fill fraction in [0, 1] (see EntryQueue.fill)."""
+        return min(len(self._pending) / self._size, 1.0)
 
     def close(self) -> None:
         with self._mu:

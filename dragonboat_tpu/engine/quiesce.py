@@ -37,6 +37,19 @@ class QuiesceManager:
             self._quiesced = True
             self.idle_since = self.current_tick
 
+    def wake_on_admit(self) -> bool:
+        """Serving-front admission against this group: exit quiesce NOW
+        (before the admitted op reaches the step loop) so the first
+        proposal of a burst pays at most one tick of wake latency, not a
+        full activity-detection round trip. Returns True when the group
+        was actually quiesced — the serving plane counts real wakes, and
+        an already-active group must not inflate the ledger. The normal
+        re-quiesce path (threshold idle ticks after the burst drains)
+        is untouched."""
+        woke = self.quiesced()
+        self.record_activity()
+        return woke
+
     def tick(self) -> bool:
         """Advance; returns True when the peer should get a quiesced tick."""
         self.current_tick += 1

@@ -255,6 +255,7 @@ class VectorNode(Node):
         self._vec_new_node = new_node
         self._vec_addresses = list(peer_addresses)
         self._vec_lane = None  # bound by VectorEngine.add_node
+        self._vec_wake_counted = False  # see notify_admission
         return None  # no scalar Peer
 
     @property
@@ -307,6 +308,31 @@ class VectorNode(Node):
             "node_id": self._node_id,
             "applied": self.sm.last_applied_index(),
         }
+
+    def notify_admission(self) -> bool:
+        """Serving-front first-admit wake (see Node.notify_admission).
+        Vector quiesce lives in the kernel plane; the decode-maintained
+        _m_quiesced mirror says whether this lane was quiesced as of its
+        last step (zero device syncs). The admitted op's arrival stages
+        the wake NOOP itself (_pack wakes quiesced lanes with fresh host
+        work); marking the lane ready here just lets the loop turn
+        immediately instead of waiting out the pump interval."""
+        lane = self._vec_lane
+        if lane is None or not lane.active:
+            return False
+        if not bool(self.engine._m_quiesced[lane.g]):
+            self._vec_wake_counted = False
+            return False
+        # the mirror stays stale until the next decode clears it: a burst
+        # of admits against one quiesced lane is ONE quiesced->active
+        # transition, so only the first admit reports (and counts) a wake
+        # — matching the scalar QuiesceManager.wake_on_admit semantics.
+        # Later admits still nudge the loop (cheap, idempotent).
+        self.engine.set_node_ready(self.cluster_id)
+        if self._vec_wake_counted:
+            return False
+        self._vec_wake_counted = True
+        return True
 
     def _leader_event(self, leader_id: int, term: int) -> None:
         """Engine loop: the lane's (leader, term) changed this step."""
@@ -1018,6 +1044,13 @@ class VectorEngine:
         self._dirty: Set[tuple] = set()  # lane keys with host events
         self._gc_set: Set[tuple] = set()  # lane keys with pending requests
         self._pending_ticks: Dict[int, int] = {}  # host -> coalesced ticks
+        # ---- serving-plane backpressure mirrors --------------------------
+        # refreshed once per _pack from data the pack pass already touches
+        # (zero device syncs); read lock-free by pressure_stats — a torn
+        # read costs one stale sample, never a wrong decision stream
+        self._p_inbox_rows = 0
+        self._p_inbox_lanes = 0
+        self._p_staged_backlog = 0
         # ---- loop-thread-only work sets ----------------------------------
         self._carry: Set[_Lane] = set()  # lanes with leftover staged work
         self._catchups: Set[_Lane] = set()  # lanes replaying host log
@@ -1746,6 +1779,21 @@ class VectorEngine:
                 self._carry.add(lane)
             if lane.pack_info:
                 packs[lane] = lane.pack_info
+        # serving backpressure mirrors: rows packed vs this step's lane
+        # capacity, and the staged backlog the carry set drags into the
+        # next step (leftover staged work means the inbox could not drain
+        # the offered load — the engine-side saturation signal). Row
+        # count captured BEFORE the flush clears the staging columns.
+        self._p_inbox_rows = len(self._rows["g"])
+        self._p_inbox_lanes = len(work)
+        backlog = 0
+        for lane in self._carry:
+            backlog += (
+                len(lane.staged_props)
+                + len(lane.staged_reads)
+                + len(lane.staged_ccs)
+            )
+        self._p_staged_backlog = backlog
         self._flush_staged_rows()
         return had, packs
 
@@ -3312,6 +3360,21 @@ class VectorEngine:
         entries handed to the RSM) — derived host-side from the decoded
         StepOutput, so reading them costs nothing on the device."""
         return dict(self._sstats)
+
+    def pressure_stats(self) -> dict:
+        """Serving-front backpressure probe (serving.backpressure.
+        SaturationMonitor): inbox-row occupancy of the last packed step
+        (fraction of the worked lanes' K-row capacity actually filled)
+        and the staged-row backlog carried between steps. Plain reads of
+        the pack-maintained counters — lock-free, zero device syncs."""
+        lanes = self._p_inbox_lanes
+        if not lanes:
+            return {"inbox_occupancy": 0.0, "staged_backlog": 0}
+        return {
+            "inbox_occupancy": self._p_inbox_rows
+            / (lanes * self.kcfg.inbox_depth),
+            "staged_backlog": self._p_staged_backlog,
+        }
 
     def lane_stats(self) -> Dict[tuple, dict]:
         """Per-lane introspection derived ENTIRELY from the numpy mirrors

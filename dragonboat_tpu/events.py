@@ -97,6 +97,24 @@ class Histogram:
         with self._mu:
             return list(self.counts), self.sum, self.count
 
+    def since(self, prev: Optional[Tuple[List[int], float, int]]) -> "Histogram":
+        """A NEW histogram holding only the observations made after
+        `prev` (a snapshot() of this histogram; None means everything).
+        Delta semantics for verdicts over cumulative per-host series —
+        e.g. one overload storm's urgent p99 on a host that has already
+        run other storms."""
+        h = Histogram(self.bounds)
+        counts, s, c = self.snapshot()
+        if prev is None:
+            h.counts = counts
+            h.sum, h.count = s, c
+            return h
+        pc, ps, pn = prev
+        h.counts = [max(a - b, 0) for a, b in zip(counts, pc)]
+        h.sum = max(s - ps, 0.0)
+        h.count = max(c - pn, 0)
+        return h
+
 
 def _labels(pairs) -> str:
     """Prometheus label block with SORTED label keys."""
@@ -131,6 +149,16 @@ class MetricsRegistry:
         self._counters: Dict[str, Dict[_LabelKey, float]] = {}
         self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
         self._hists: Dict[str, Dict[_LabelKey, Histogram]] = {}
+        # per-metric label NAMES for the 2-tuple keys; families not
+        # declared here expose the historical ("clusterid", "nodeid")
+        self._label_names: Dict[str, tuple] = {}
+
+    def declare_label_names(self, name: str, names) -> None:
+        """Install the label names a metric family's 2-tuple keys mean
+        (e.g. the serving plane's ("tenant", "klass")). Idempotent;
+        undeclared families keep ("clusterid", "nodeid")."""
+        with self._mu:
+            self._label_names[name] = tuple(names)
 
     def inc(self, name: str, key: _LabelKey, delta: float = 1.0) -> None:
         with self._mu:
@@ -172,6 +200,12 @@ class MetricsRegistry:
         with self._mu:
             return list(self._hists.get(name, {}).values())
 
+    def histogram_items(self, name: str) -> List[Tuple[_LabelKey, Histogram]]:
+        """(key, histogram) pairs for `name` — key-aware merges (the
+        bench serving fold splits urgent vs bulk by the klass label)."""
+        with self._mu:
+            return list(self._hists.get(name, {}).items())
+
     def write(self, w) -> None:
         """Prometheus text exposition (cf. WriteHealthMetrics event.go:30).
         One `# TYPE` line per metric family; cumulative histogram buckets
@@ -180,19 +214,21 @@ class MetricsRegistry:
             for kind, table in (("counter", self._counters), ("gauge", self._gauges)):
                 for name in sorted(table):
                     full = f"{self._prefix}_{name}"
+                    lnames = self._label_names.get(
+                        name, ("clusterid", "nodeid")
+                    )
                     w.write(f"# TYPE {full} {kind}\n")
-                    for (cid, nid), v in sorted(table[name].items()):
+                    for key, v in sorted(table[name].items()):
                         w.write(
-                            f"{full}"
-                            f"{_labels((('clusterid', cid), ('nodeid', nid)))}"
-                            f" {v:g}\n"
+                            f"{full}{_labels(tuple(zip(lnames, key)))} {v:g}\n"
                         )
             for name in sorted(self._hists):
                 full = f"{self._prefix}_{name}"
+                lnames = self._label_names.get(name, ("clusterid", "nodeid"))
                 w.write(f"# TYPE {full} histogram\n")
-                for (cid, nid), h in sorted(self._hists[name].items()):
+                for key, h in sorted(self._hists[name].items()):
                     write_histogram_series(
-                        w, full, (("clusterid", cid), ("nodeid", nid)), h
+                        w, full, tuple(zip(lnames, key)), h
                     )
 
 

@@ -369,6 +369,56 @@ class FaultPlane:
             yield victim, down, idle, tear
             budget -= down + idle
 
+    # ----------------------------------------------------- overload storms
+    def overload_storm_schedule(
+        self,
+        site: str,
+        tenants,
+        total_s: float,
+        min_window_s: float = 0.2,
+        max_window_s: float = 0.6,
+    ):
+        """Yield a seeded sequence of (profile, mult, window_s, weights)
+        overload windows covering ~total_s seconds — the serving front's
+        storm scenario (see serving/storm.py). `profile` is "burst"
+        (short, 2-4x offered load) or "sustained" (longer, 1.5-2.5x);
+        `mult` multiplies each tenant's admitted capacity into its
+        OFFERED load; `weights` skews the tenant mix per window (seeded
+        per tenant in sorted order, so the draw sequence — and the
+        schedule signature — replays bit-identically for the same
+        seed). The caller drives traffic per window; op counts derived
+        from (mult, window_s) keep the replayed op sequence identical
+        without wall-clock coupling."""
+        budget = total_s
+        tenants = sorted(tenants)
+        while budget > 0:
+            profile = self.choice(
+                site, "storm_profile", ["burst", "sustained"]
+            )
+            if profile == "burst":
+                mult = self.uniform(site, "storm_mult", 2.0, 4.0)
+                window = self.uniform(
+                    site, "storm_window", min_window_s,
+                    (min_window_s + max_window_s) / 2,
+                )
+            else:
+                mult = self.uniform(site, "storm_mult", 1.5, 2.5)
+                window = self.uniform(
+                    site, "storm_window",
+                    (min_window_s + max_window_s) / 2, max_window_s,
+                )
+            weights = {
+                t: round(self.uniform(site, "storm_weight", 0.5, 2.0), 6)
+                for t in tenants
+            }
+            flight_recorder().record(
+                "overload_storm_window", site=site, profile=profile,
+                mult=round(mult, 4), window_s=round(window, 4),
+                seed=self.seed,
+            )
+            yield profile, mult, window, weights
+            budget -= window
+
     def tear_wal_tails(self, logdb_dir: str, site: str) -> int:
         """Tear the tail of every shard WAL under a CLOSED ShardedLogDB
         root (shard-<i>/wal.log) — the disk half of a crash_restart

@@ -205,6 +205,11 @@ class NodeHost(IMessageHandler):
         )
         self._tick_thread.start()
         self._partitioned = False  # monkey-test knob
+        # lazily-created overload-robust ingress (serving/front.py); read
+        # lock-free by the gauge exporter, created/torn down under
+        # _serving_mu
+        self._serving = None
+        self._serving_mu = threading.Lock()
         # ping/pong RTT samples: (cluster_id, peer) -> deque of microseconds
         self._rtt_mu = threading.Lock()
         self._rtt: Dict[tuple, object] = {}
@@ -279,6 +284,13 @@ class NodeHost(IMessageHandler):
 
     def _teardown(self, crashed: bool) -> None:
         self._stopped.set()
+        with self._serving_mu:
+            front, self._serving = self._serving, None
+        if front is not None and not crashed:
+            # graceful stop drains queued tickets with ErrClusterClosed;
+            # a crash abandons them exactly like every other in-flight
+            # request on this host
+            front.stop()
         with self._nodes_mu:
             nodes = list(self._nodes.values())
             self._nodes.clear()
@@ -727,6 +739,53 @@ class NodeHost(IMessageHandler):
     def stale_read(self, cluster_id: int, query):
         node = self._get_node(cluster_id)
         return node.sm.lookup(query)
+
+    # --------------------------------------------------------- serving front
+    def serving_front(self, admission=None, front=None):
+        """The overload-robust ingress for this host (serving/front.py):
+        per-tenant admission control + weighted-fair fan-in onto the
+        batched propose path, fed by this host's live backpressure
+        signals. Created lazily, ONE per host (the first call's knobs
+        win); stop() tears it down with the host. Its per-tenant
+        admit/shed/latency ledger exports through write_health_metrics
+        alongside every other gauge."""
+        with self._serving_mu:
+            if self._serving is None:
+                from .serving import ServingFront
+
+                self._serving = ServingFront(
+                    self, admission=admission, front=front
+                )
+            return self._serving
+
+    def ingress_fill(self) -> float:
+        """Worst incoming-proposal/read queue fill across this host's
+        groups, in [0, 1] — the request-pool backpressure signal the
+        serving front's SaturationMonitor folds into admission (a full
+        queue here is the ErrSystemBusy raise site one add() later).
+        Lock-free queue probes; a torn read costs one stale sample."""
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        fill = 0.0
+        for node in nodes:
+            fill = max(
+                fill,
+                node.incoming_proposals.fill(),
+                node.incoming_reads.fill(),
+            )
+        return fill
+
+    def notify_group_admission(self, cluster_id: int) -> bool:
+        """Serving-front first-admit wake (engine/quiesce.py contract):
+        returns True when the group was idle-quiesced and is being woken
+        ahead of the admitted op reaching the step loop. Unknown groups
+        are a no-op — admission must not fail before the real propose
+        path gets to say ErrClusterNotFound itself."""
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None:
+            return False
+        return node.notify_admission()
 
     # -------------------------------------------------------------- sessions
     def get_noop_session(self, cluster_id: int) -> Session:
@@ -1269,6 +1328,12 @@ class NodeHost(IMessageHandler):
         # per-lane (cluster_id-labelled) introspection from the engine's
         # numpy mirrors: leader, term, commit gap, ticks since the last
         # leader change — zero device syncs (see VectorEngine.lane_stats)
+        # serving-front overload plane: the per-tenant admit/shed/wake
+        # ledger, queue depths and the folded saturation score (the
+        # latency histograms are fed live by the completion callbacks)
+        front = self._serving
+        if front is not None:
+            front.export_gauges(self.metrics)
         lane_stats = getattr(self.engine, "lane_stats", None)
         if lane_stats is not None:
             for cid, s in lane_stats().items():

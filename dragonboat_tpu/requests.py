@@ -57,7 +57,15 @@ class ErrRejected(RequestError):
 
 
 class ErrSystemBusy(RequestError):
+    """Overload shed: fail fast, safe to retry. `retry_after_s` is the
+    machine-readable backoff hint (0.0 = none); the serving plane's
+    typed subclasses (serving.admission.ErrOverloaded family) populate
+    it, and serving.retry.call_with_retries honors it as a backoff
+    floor — so every ErrSystemBusy anywhere in the stack reads uniformly
+    at the client."""
+
     code = "system is too busy, try again later"
+    retry_after_s = 0.0
 
 
 class ErrInvalidSession(RequestError):
@@ -397,6 +405,11 @@ class _ProposalShard:
     def has_pending(self) -> bool:
         return bool(self._pending)
 
+    def pending_count(self) -> int:
+        """Lock-free in-flight count (backpressure probe; a torn read
+        costs one stale sample, never a wrong decision stream)."""
+        return len(self._pending)
+
 
 class PendingProposal:
     """Sharded in-flight proposal registry (cf. pendingProposal
@@ -458,6 +471,10 @@ class PendingProposal:
     def has_pending(self) -> bool:
         return any(s.has_pending() for s in self._shards)
 
+    def pending_count(self) -> int:
+        """Total in-flight proposals across shards (backpressure probe)."""
+        return sum(s.pending_count() for s in self._shards)
+
 
 class PendingReadIndex:
     """ReadIndex batching: many user reads share one system context
@@ -490,6 +507,13 @@ class PendingReadIndex:
 
     def has_pending(self) -> bool:
         return bool(self._queued or self._batches)
+
+    def pending_count(self) -> int:
+        """Queued + bound-but-unreleased reads (backpressure probe;
+        lock-free, torn reads cost one stale sample)."""
+        return len(self._queued) + sum(
+            len(b) for b in self._batches.values()
+        )
 
     def has_ctx(self, ctx: SystemCtx) -> bool:
         """Whether a bound batch is still alive for ctx (engine-side

@@ -1,0 +1,44 @@
+"""Overload robustness plane for the serving front.
+
+Everything between client traffic and the engines' batched propose path
+lives here: per-tenant admission control (token buckets + weighted fair
+dequeue), end-to-end backpressure (one saturation score folded from the
+WAL barrier, the engine inbox and the request pools), typed overload
+errors with retry-after hints, a deadline-honoring client retry helper,
+and the seeded `overload_storm` scenario with its graceful-degradation
+verdict. See README "Serving & overload".
+"""
+from .admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ErrBackpressure,
+    ErrOverloaded,
+    ErrTenantThrottled,
+    KLASS_BULK,
+    KLASS_URGENT,
+    TenantSpec,
+    TokenBucket,
+)
+from .backpressure import SaturationMonitor, SaturationThresholds
+from .front import ServingFront, Ticket
+from .retry import call_with_retries
+from .storm import StormReport, run_overload_storm, storm_burst
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "ErrBackpressure",
+    "ErrOverloaded",
+    "ErrTenantThrottled",
+    "KLASS_BULK",
+    "KLASS_URGENT",
+    "SaturationMonitor",
+    "SaturationThresholds",
+    "ServingFront",
+    "StormReport",
+    "TenantSpec",
+    "Ticket",
+    "call_with_retries",
+    "run_overload_storm",
+    "storm_burst",
+]

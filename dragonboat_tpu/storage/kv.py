@@ -135,6 +135,80 @@ class IKVStore:
         return None
 
 
+class _BarrierStats:
+    """Process-global durability-barrier pressure gauge: how many real
+    fsync barriers are in flight right now (the WAL "fsync queue depth"
+    — during a sync_all wave every touched shard counts) and an EWMA of
+    barrier wall latency. This is a first-class backpressure SIGNAL (the
+    serving front's SaturationMonitor folds it into admission), not just
+    telemetry: when the barrier saturates, admission must tighten BEFORE
+    the save wave starts stalling the engine step loop. Cost: one small
+    lock + a few float ops per fsync — barriers are ms-scale."""
+
+    __slots__ = ("_mu", "ewma_s", "last_s", "last_wave_s", "inflight",
+                 "barriers")
+
+    # EWMA smoothing: ~the last 5 barriers dominate, so a single slow
+    # outlier neither saturates admission nor hides a real trend
+    ALPHA = 0.2
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.ewma_s = 0.0
+        self.last_s = 0.0
+        self.last_wave_s = 0.0  # last sync_all wave wall time
+        self.inflight = 0
+        self.barriers = 0
+
+    def enter(self) -> None:
+        with self._mu:
+            self.inflight += 1
+
+    def exit(self, seconds: float) -> None:
+        with self._mu:
+            self.inflight = max(self.inflight - 1, 0)
+            self.last_s = seconds
+            self.ewma_s = (
+                seconds if self.barriers == 0
+                else (1 - self.ALPHA) * self.ewma_s + self.ALPHA * seconds
+            )
+            self.barriers += 1
+
+    def note_wave(self, seconds: float) -> None:
+        with self._mu:
+            self.last_wave_s = seconds
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "ewma_s": self.ewma_s,
+                "last_s": self.last_s,
+                "last_wave_s": self.last_wave_s,
+                "inflight": self.inflight,
+                "barriers": self.barriers,
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.ewma_s = self.last_s = self.last_wave_s = 0.0
+            self.inflight = 0
+            self.barriers = 0
+
+
+_barrier_stats = _BarrierStats()
+
+
+def barrier_stats() -> dict:
+    """Snapshot of the process-global WAL-barrier pressure signal:
+    {ewma_s, last_s, last_wave_s, inflight, barriers}."""
+    return _barrier_stats.snapshot()
+
+
+def reset_barrier_stats() -> None:
+    """Test seam: zero the process-global barrier signal."""
+    _barrier_stats.reset()
+
+
 class MemKV(IKVStore):
     """Ordered in-memory store: dict + lazily sorted key list."""
 
@@ -274,19 +348,31 @@ class WalKV(IKVStore):
         self._since_compact = 0
         # fsync-latency observer (cb(seconds)); None = zero extra work
         self._fsync_observer: Optional[Callable[[float], None]] = None
+        # per-store barrier-pressure gauge: one NodeHost's saturation
+        # must never shed another co-hosted NodeHost's traffic, so
+        # ShardedLogDB.barrier_stats() aggregates THESE per host while
+        # the process-global gauge keeps the whole-process picture
+        self.bstats = _BarrierStats()
 
     def set_fsync_observer(self, cb: Optional[Callable[[float], None]]) -> None:
         self._fsync_observer = cb
 
     def _barrier(self) -> None:
-        """The durability barrier, timed when an observer is installed."""
+        """The durability barrier: always timed into the process-global
+        barrier-pressure signal (backpressure for admission control) and
+        additionally reported to the histogram observer when installed."""
         obs = self._fsync_observer
-        if obs is None:
-            os.fsync(self._f.fileno())
-            return
+        _barrier_stats.enter()
+        self.bstats.enter()
         t0 = time.monotonic()
-        os.fsync(self._f.fileno())
-        obs(time.monotonic() - t0)
+        try:
+            os.fsync(self._f.fileno())
+        finally:
+            dt = time.monotonic() - t0
+            _barrier_stats.exit(dt)
+            self.bstats.exit(dt)
+        if obs is not None:
+            obs(dt)
 
     def name(self) -> str:
         return "walkv"
@@ -457,24 +543,44 @@ def sync_all(kvs) -> None:
     """One durability barrier over many stores: fsync every store in
     parallel and return once ALL are durable (the group-commit half of
     commit_write_batch_deferred). Raises the first failure after every
-    sync has settled — a failed barrier must not report durable."""
+    sync has settled — a failed barrier must not report durable. The
+    wave's wall time lands in the barrier-pressure signal
+    (barrier_stats) alongside the per-fsync depth/latency the member
+    barriers record themselves."""
     unique = list(dict.fromkeys(kvs))
     if not unique:
         return
-    if len(unique) == 1:
-        unique[0].sync()
-        return
-    pool = _get_sync_pool()
-    futures = [pool.submit(kv.sync) for kv in unique]
-    first_exc = None
-    for f in futures:
-        try:
-            f.result()
-        except Exception as e:  # noqa: BLE001 - re-raised below
-            if first_exc is None:
-                first_exc = e
-    if first_exc is not None:
-        raise first_exc
+    t0 = time.monotonic()
+    try:
+        if len(unique) == 1:
+            unique[0].sync()
+            return
+        pool = _get_sync_pool()
+        futures = [pool.submit(kv.sync) for kv in unique]
+        first_exc = None
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+    finally:
+        dt = time.monotonic() - t0
+        _barrier_stats.note_wave(dt)
+        for kv in unique:  # one wave = one host's save fan-out
+            bs = getattr(kv, "bstats", None)
+            if bs is not None:
+                bs.note_wave(dt)
 
 
-__all__ = ["IKVStore", "WriteBatch", "MemKV", "WalKV", "sync_all"]
+__all__ = [
+    "IKVStore",
+    "WriteBatch",
+    "MemKV",
+    "WalKV",
+    "barrier_stats",
+    "reset_barrier_stats",
+    "sync_all",
+]

@@ -283,6 +283,32 @@ class ShardedLogDB(ILogDB):
             if set_obs is not None:
                 set_obs(cb)
 
+    def barrier_stats(self) -> dict:
+        """THIS logdb's durability-barrier pressure, aggregated across
+        shard stores (serving.backpressure probes it so one host's fsync
+        saturation never sheds a co-hosted NodeHost's traffic).
+        Bottleneck semantics: latencies are the MAX across shards;
+        in-flight barriers SUM (a sync_all wave fsyncs many shards at
+        once — the depth IS the wave width). Memory-backed shards
+        contribute nothing."""
+        out = {
+            "ewma_s": 0.0, "last_s": 0.0, "last_wave_s": 0.0,
+            "inflight": 0, "barriers": 0,
+        }
+        for s in self._shards:
+            bs = getattr(s.kv, "bstats", None)
+            if bs is None:
+                continue
+            snap = bs.snapshot()
+            out["ewma_s"] = max(out["ewma_s"], snap["ewma_s"])
+            out["last_s"] = max(out["last_s"], snap["last_s"])
+            out["last_wave_s"] = max(
+                out["last_wave_s"], snap["last_wave_s"]
+            )
+            out["inflight"] += snap["inflight"]
+            out["barriers"] += snap["barriers"]
+        return out
+
     def close(self) -> None:
         for s in self._shards:
             s.kv.close()

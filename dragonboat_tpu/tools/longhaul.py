@@ -88,6 +88,7 @@ SCENARIOS = (
     "churn",
     "transfer",
     "snapshot",
+    "overload",
     "none",
 )
 
@@ -277,6 +278,10 @@ class _Round:
         self.churn_ids: List[int] = []  # joined-and-not-yet-removed ids
         self._next_churn_id = CHURN_HOST
         self._crash_gen = None
+        # overload-scenario ledger folded into the round verdicts: across
+        # every burst this round, urgent ops must never shed and every
+        # bulk shed must carry a retry-after hint (serving/storm.py)
+        self._storm = {"bursts": 0, "urgent_shed": 0, "hints_ok": True}
 
     # ------------------------------------------------------------ lifecycle
     def run(self) -> RoundResult:
@@ -461,6 +466,29 @@ class _Round:
             nh.request_snapshot(CLUSTER, timeout_s=5.0)
             time.sleep(0.1)
 
+    def _op_overload(self) -> None:
+        """Seeded overload burst through a throw-away serving front on
+        the leader host (serving/storm.py storm_burst): offered bulk at
+        the seeded multiple of admitted capacity plus interleaved urgent
+        reads. Bulk must shed fast with retry hints; urgent must never
+        shed — folded into the round verdicts (overload_*)."""
+        from ..serving.storm import storm_burst
+
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        if leader is None:
+            return  # no steerable group mid-fault: nothing to overload
+        nh = self.hosts.get(leader)
+        if nh is None:
+            return
+        out = storm_burst(
+            nh, CLUSTER, self.fp,
+            burst_s=0.25, capacity_rate=400.0, timeout_s=4.0,
+        )
+        st = self._storm
+        st["bursts"] += 1
+        st["urgent_shed"] += out["urgent_shed"]
+        st["hints_ok"] = st["hints_ok"] and out["retry_hints_ok"]
+
     def _op_churn(self) -> None:
         """Membership churn: join a FRESH node id on the churn host, or
         remove the oldest joined one (removed ids are never reused —
@@ -606,6 +634,12 @@ class _Round:
             if stats is not None:
                 worst_gap = max(worst_gap, stats()["recent_max_gap_s"])
         v["fairness_no_stall"] = worst_gap < 5.0
+        # overload robustness (only when the scenario fired this round):
+        # across every burst, zero urgent-class ops shed and every bulk
+        # shed carried a machine-readable retry-after hint
+        if self._storm["bursts"]:
+            v["overload_no_urgent_shed"] = self._storm["urgent_shed"] == 0
+            v["overload_hints_ok"] = self._storm["hints_ok"]
 
     # ------------------------------------------------------------ artifacts
     def _bundle_failure(self) -> None:

@@ -46,6 +46,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "serving: the overload robustness gate (dragonboat_tpu.serving) — "
+        "admission control, backpressure folding, deadline-aware retry, "
+        "quiesce wake-on-admit, and the seeded overload_storm graceful-"
+        "degradation verdict; run it alone with `-m serving`",
+    )
+    config.addinivalue_line(
+        "markers",
         "longhaul: the drummer-style long-haul runner's bounded smoke "
         "profile (tools.longhaul with a tight --budget, <60s) — tier-1 "
         "proves the runner end to end (rounds, verdicts, failure "

@@ -646,3 +646,85 @@ def test_randomized_convergence_with_drops():
         for r in nt.rafts.values()
     ]
     assert logs[0] == logs[1] == logs[2]
+
+
+# ------------------------------------- committed>applied config-change scan
+
+
+def _raft_with_window(cc_at=(), n=6, payload=16):
+    """A raft with n committed-but-unapplied entries (config changes at
+    the 1-based indexes in cc_at)."""
+    r = new_test_raft(1, [1, 2, 3])
+    ents = [
+        Entry(
+            index=i,
+            term=1,
+            type=(
+                EntryType.CONFIG_CHANGE
+                if i in cc_at
+                else EntryType.APPLICATION
+            ),
+            cmd=b"x" * payload,
+        )
+        for i in range(1, n + 1)
+    ]
+    r.log.append(ents)
+    r.log.committed = n
+    assert r.applied == 0
+    return r
+
+
+def test_unapplied_window_scan_is_precise():
+    """The committed>applied scan (raft.go:1461-1470 notes it as a TODO
+    and conservatively always refuses): a window WITHOUT a config change
+    must not block campaigning, one WITH must."""
+    assert not _raft_with_window()._has_config_change_to_apply()
+    assert _raft_with_window(cc_at=(3,))._has_config_change_to_apply()
+    assert _raft_with_window(cc_at=(6,))._has_config_change_to_apply()
+
+
+def test_unapplied_window_scan_crosses_max_size_batches(monkeypatch):
+    """Regression: the scan must CONTINUE past a max_entry_size-limited
+    first batch — a config change at the window's tail must be found."""
+    from dragonboat_tpu import settings
+
+    # ~2 entries per batch (entry size = len(cmd) + 48)
+    monkeypatch.setattr(settings.soft, "max_entry_size", 150)
+    r = _raft_with_window(cc_at=(6,), n=6, payload=16)
+    assert r._has_config_change_to_apply()
+    r2 = _raft_with_window(n=6, payload=16)
+    monkeypatch.setattr(settings.soft, "max_entry_size", 150)
+    assert not r2._has_config_change_to_apply()
+
+
+def test_unapplied_window_unfetchable_is_conservative(monkeypatch):
+    """Regression for the imprecise fallback: when part of the window
+    cannot be read (storage truncated a batch to nothing, or the scan
+    raced a compaction), the answer must be the reference's conservative
+    True (refuse to campaign) — an unseen entry might be a config
+    change. The old fallback answered False and allowed campaigning
+    across a possibly-pending quorum change."""
+    from dragonboat_tpu.core.logentry import EntryLog, ErrCompacted
+
+    r = _raft_with_window()
+    monkeypatch.setattr(EntryLog, "get_entries", lambda self, lo, hi, mx: [])
+    assert r._has_config_change_to_apply()
+
+    def boom(self, lo, hi, mx):
+        raise ErrCompacted
+
+    monkeypatch.setattr(EntryLog, "get_entries", boom)
+    assert r._has_config_change_to_apply()
+
+
+def test_election_skipped_while_config_change_unapplied_via_scan():
+    """End to end through the election handler: the precise scan (not
+    the injected has_not_applied_config_change callback) refuses the
+    campaign while a committed config change awaits apply, and allows
+    it once the window is clean."""
+    r = _raft_with_window(cc_at=(2,))
+    tick_until_election(r)
+    assert r.state == F  # campaign refused by the scan
+    r.applied = r.log.committed  # window drained: free to campaign
+    tick_until_election(r)
+    assert r.state in (C, L)

@@ -738,3 +738,85 @@ def test_restart_plane_lock_order_nodes_mu_before_node_mu():
         families=("locks",),
     )
     assert _ids(got) == ["locks/order"], got
+
+
+def test_serving_plane_locks_are_declared():
+    """ISSUE 8: the serving overload plane's shared state is covered by
+    the lock config — front queue table outside the admission ledger
+    outside the saturation cache, token bucket + barrier gauge as
+    leaves, and all of them INSIDE the host/engine locks the pump path
+    releases before calling into."""
+    front = DEFAULT_TARGETS.lock_rank("ServingFront", "_mu")
+    adm = DEFAULT_TARGETS.lock_rank("AdmissionController", "_mu")
+    mon = DEFAULT_TARGETS.lock_rank("SaturationMonitor", "_mu")
+    bucket = DEFAULT_TARGETS.lock_rank("TokenBucket", "_mu")
+    barrier = DEFAULT_TARGETS.lock_rank("_BarrierStats", "_mu")
+    for spec in (front, adm, mon, bucket, barrier):
+        assert spec is not None, "serving lock missing from the hierarchy"
+    node_mu = DEFAULT_TARGETS.lock_rank("Node", "_mu")
+    assert node_mu.rank < front.rank < adm.rank < mon.rank < bucket.rank
+    g = DEFAULT_TARGETS.guarded_state
+    assert g["serving/front.py"]["ServingFront"]["_queues"] == "_mu"
+    assert g["serving/admission.py"]["AdmissionController"]["_tenants"] == "_mu"
+    assert g["serving/admission.py"]["TokenBucket"]["tokens"] == "_mu"
+    assert g["serving/backpressure.py"]["SaturationMonitor"]["_cached"] == "_mu"
+    assert g["storage/kv.py"]["_BarrierStats"]["inflight"] == "_mu"
+
+
+def test_serving_guarded_state_catches_unlocked_ledger_writes():
+    """An admit/shed ledger or tenant-queue mutation outside its lock is
+    the lost-increment / torn-decision admission bug class; seeded
+    violations must flag and the locked idiom must stay clean."""
+    got = _run(
+        """
+        class AdmissionController:
+            def admit(self, tid):
+                self._tenants[tid] = object()
+                with self._mu:
+                    self._tenants[tid] = object()
+        class TokenBucket:
+            def take(self, n):
+                self.tokens -= n
+        """,
+        "serving/admission.py",
+        families=("locks",),
+    )
+    assert _ids(got) == [
+        "locks/guarded-state", "locks/guarded-state",
+    ], got
+    got = _run(
+        """
+        class ServingFront:
+            def propose(self, tid, op):
+                self._queues.setdefault(tid, []).append(op)
+            def queue_depths(self):
+                with self._mu:
+                    return {t: len(q) for t, q in self._queues.items()}
+        """,
+        "serving/front.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/guarded-state"], got
+
+
+def test_serving_lock_order_front_inside_node_flags():
+    """The pump must NEVER hold the front's queue lock while taking a
+    node/host lock ranked outer — that inversion is how a saturated
+    engine deadlocks its own shedding path."""
+    got = _run(
+        """
+        class ServingFront:
+            def bad(self, node):
+                with self._mu:
+                    with node._mu:
+                        pass
+            def good(self, node):
+                with node._mu:
+                    pass
+                with self._mu:
+                    pass
+        """,
+        "serving/front.py",
+        families=("locks",),
+    )
+    assert _ids(got) == ["locks/order"], got
