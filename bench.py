@@ -36,6 +36,26 @@ from dragonboat_tpu._jaxenv import enable_compile_cache, maybe_pin_cpu, pin_cpu
 BASELINE_PROPOSALS_PER_SEC = 9_000_000  # reference README.md:46 (3-node peak)
 
 
+def _host_stamp() -> dict:
+    """Bench-honesty box fingerprint: hostname/cpu-count identity plus a
+    timed fixed numpy spin (a human-readable load indicator for the
+    trajectory). tools.perfdiff refuses to diff records whose ids differ
+    — re-benching one commit on a second box of this repo's own
+    trajectory showed a 1.65x throughput gap at identical code/shape."""
+    import platform as _platform
+    import numpy as _np
+
+    t0 = time.perf_counter()
+    a = _np.random.default_rng(0).random((256, 256))
+    for _ in range(20):
+        a = (a @ a) % 1.0
+    calib = time.perf_counter() - t0
+    return {
+        "id": f"{_platform.node() or 'unknown'}/{os.cpu_count()}cpu",
+        "calib_s": round(calib, 4),
+    }
+
+
 def _ensure_live_backend(max_wait_s: float = 300.0) -> str:
     """Probe JAX backend init in a subprocess before touching it in-process.
 
@@ -198,6 +218,7 @@ def bench_e2e(
     read_ratio: int = 0,
     drop_rate: float = 0.0,
     churn: bool = False,
+    steps_per_sync: int = 1,
 ):
     """N NodeHosts, G groups x N replicas, quorum + fsync + apply.
 
@@ -211,7 +232,9 @@ def bench_e2e(
     (BASELINE config 3's 9:1 mix). drop_rate randomly drops that fraction
     of replication traffic (config 4's log-matching divergence stress).
     churn interleaves snapshot requests and membership changes during the
-    measurement (config 5)."""
+    measurement (config 5). steps_per_sync=K runs the device-resident
+    multi-step engine: K protocol steps per kernel launch with co-hosted
+    traffic routed on device (config 6 is config 2 at K=8)."""
     import random as _random
 
     from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
@@ -228,7 +251,7 @@ def bench_e2e(
         return _bench_e2e_body(
             hosts, members, reg, sm_cls, groups, duration_s, payload,
             workdir, shared, wave, inbox_depth, entries_per_msg, log_window,
-            replicas, read_ratio, drop_rate, churn,
+            replicas, read_ratio, drop_rate, churn, steps_per_sync,
         )
     finally:
         # an exception must not leak NodeHosts: the share_scope='bench'
@@ -244,7 +267,7 @@ def bench_e2e(
 def _bench_e2e_body(
     hosts, members, reg, sm_cls, groups, duration_s, payload, workdir,
     shared, wave, inbox_depth, entries_per_msg, log_window, replicas,
-    read_ratio, drop_rate, churn,
+    read_ratio, drop_rate, churn, steps_per_sync=1,
 ):
     import random as _random
 
@@ -274,7 +297,10 @@ def _bench_e2e_body(
                 log_window=log_window,
                 inbox_depth=inbox_depth,
                 max_entries_per_msg=entries_per_msg,
-                share_scope="bench" if shared else None,
+                steps_per_sync=steps_per_sync,
+                share_scope=(
+                    f"bench-k{steps_per_sync}" if shared else None
+                ),
                 # full stage sampling: the BENCH JSON carries per-stage
                 # host timings so the perf trajectory tracks where the
                 # host half of each step goes
@@ -335,7 +361,11 @@ def _bench_e2e_body(
             time.sleep(0.05)
     bring_up_s = time.monotonic() - t0
     if pending:
-        err = {"error": f"{len(pending)} groups never elected", "value": 0.0}
+        err = {
+            "error": f"{len(pending)} groups never elected",
+            "value": 0.0,
+            "steps_per_sync": steps_per_sync,
+        }
         err.update(_attribution_report(hosts, None, None))
         return err
     # warmup: the first kernel compile stalls every engine and piles ticks;
@@ -466,6 +496,10 @@ def _bench_e2e_body(
         "fsync": True,
         "shared_engine": shared,
         "wave": wave,
+        # bench honesty: K is stamped on every config so tools.perfdiff
+        # refuses to diff runs of different engines (K=1 vs K=8 measure
+        # different machines, like scaled-down vs nominal does)
+        "steps_per_sync": steps_per_sync,
     }
     if read_ratio:
         out["reads_completed"] = reads_done
@@ -743,6 +777,15 @@ LADDER = {
         nominal_groups=50_000, groups=128, replicas=5, payload=16,
         wave=64, duration=8.0, churn=True,
     ),
+    # config 2's workload on the device-resident multi-step engine: K=8
+    # protocol steps per kernel launch, co-hosted replica traffic routed
+    # on device. Kept as its OWN config id so the perfdiff trajectory
+    # never diffs it against a K=1 run of config 2 (the K honesty rule).
+    6: dict(
+        label="3-node, 1024 groups, 16B, K=8 device-resident super-steps",
+        nominal_groups=1024, groups=1024, replicas=3, payload=16,
+        wave=128, duration=10.0, steps_per_sync=8,
+    ),
 }
 
 
@@ -752,7 +795,7 @@ def _run_ladder_config(
     groups = spec["groups"]
     duration = spec["duration"]
     if not explicit_groups:
-        if cpu and n >= 3:
+        if cpu and spec["replicas"] >= 5:
             # the 5-replica configs carry 5 lanes/group; keep the host
             # half inside the watchdog budget on plain CPU boxes
             groups = min(groups, 128)
@@ -770,6 +813,7 @@ def _run_ladder_config(
             read_ratio=spec.get("read_ratio", 0),
             drop_rate=spec.get("drop_rate", 0.0),
             churn=spec.get("churn", False),
+            steps_per_sync=spec.get("steps_per_sync", 1),
         )
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -788,11 +832,14 @@ def _run_ladder_config(
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    choices=[0, 1, 2, 3, 4, 5],
+                    choices=[0, 1, 2, 3, 4, 5, 6],
                     help="run ONE BASELINE.json ladder config (1-5) at its "
                          "declared scale instead of the full reduced sweep")
     ap.add_argument("--groups", type=int, default=0,
                     help="override group count (with --config)")
+    ap.add_argument("--steps-per-sync", type=int, default=0,
+                    help="override EngineConfig.steps_per_sync (with "
+                         "--config): K protocol steps per kernel launch")
     ap.add_argument("--duration", type=float, default=0.0)
     ap.add_argument("--kernel-groups", type=int, default=50_000)
     ap.add_argument("--kernel-steps", type=int, default=50)
@@ -829,6 +876,7 @@ def main() -> None:
     sync_audit().install()
 
     RECORD["platform"] = platform
+    RECORD["host"] = _host_stamp()
     if platform == "cpu-fallback":
         RECORD["degraded"] = "accelerator unreachable; reduced CPU workload"
     if not args.skip_e2e:
@@ -844,6 +892,8 @@ def main() -> None:
                     spec["groups"] = spec["nominal_groups"]
                 if args.duration:
                     spec["duration"] = args.duration
+                if args.steps_per_sync:
+                    spec["steps_per_sync"] = args.steps_per_sync
             try:
                 configs[str(n)] = _run_ladder_config(
                     n, spec, cpu,

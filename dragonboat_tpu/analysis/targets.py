@@ -139,7 +139,22 @@ def _default_targets() -> Targets:
         (VECTOR, "VectorEngine._stage_row"),
         (VECTOR, "VectorEngine._flush_staged_rows"),
         (VECTOR, "VectorEngine._fetch_output"),
+        (VECTOR, "VectorEngine._fetch_super"),
         (VECTOR, "VectorEngine._decode"),
+        # the decode phase bodies (split out of _decode so the K-step
+        # super-step path orchestrates the same code) and the multi-step
+        # super-step machinery — all run once per engine step / inner step
+        (VECTOR, "VectorEngine._decode_super"),
+        (VECTOR, "VectorEngine._decode_place"),
+        (VECTOR, "VectorEngine._refresh_mirrors"),
+        (VECTOR, "VectorEngine._decode_send_rep"),
+        (VECTOR, "VectorEngine._commit_saves"),
+        (VECTOR, "VectorEngine._decode_send_post"),
+        (VECTOR, "VectorEngine._decode_apply"),
+        (VECTOR, "VectorEngine._decode_reads"),
+        (VECTOR, "VectorEngine._routed_rep_plan"),
+        (VECTOR, "VectorEngine._place_routed_reps"),
+        (VECTOR, "VectorEngine._mask_routed"),
         (VECTOR, "VectorEngine._dispatch_sends"),
         (VECTOR, "VectorEngine._save_updates"),
         (VECTOR, "VectorEngine.try_local_deliver_many"),
@@ -175,6 +190,8 @@ def _default_targets() -> Targets:
         (VECTOR, "gather_resp_sends"),
         (VECTOR, "VectorEngine._pack_wire"),
         (VECTOR, "VectorEngine._decode"),
+        # the quorum_commit stamp moved into the split-out apply phase
+        (VECTOR, "VectorEngine._decode_apply"),
         (TRANSPORT, "Transport.send_many"),
     }
     # the declared lock hierarchy, outermost first. Acquisition must go
@@ -368,12 +385,21 @@ def _default_targets() -> Targets:
         hot_lock_functions=hot_lock,
         hot_telemetry_functions=hot_telemetry,
         hot_trace_functions=hot_trace,
-        blessed_device_get={(VECTOR, "VectorEngine._fetch_output")},
+        blessed_device_get={
+            (VECTOR, "VectorEngine._fetch_output"),
+            # the multi-step engine's once-per-K-steps consolidated
+            # transfer (mirrors profile.SyncAudit.BLESSED)
+            (VECTOR, "VectorEngine._fetch_super"),
+        },
         device_roots={"self._state"},
         traced_modules={KERNEL},
-        traced_exempt={"make_step_fn"},
+        traced_exempt={"make_step_fn", "make_multi_step_fn"},
         traced_functions={(VECTOR, "_make_activate_fn.apply")},
-        static_param_names={"cfg", "donate"},
+        # `steps` is the super-step scan length: a compile-time constant
+        # baked into the executable by make_multi_step_fn (a traced K
+        # would rebuild the scan per value — the retrace family's
+        # recompile-hazard meta-test covers exactly this)
+        static_param_names={"cfg", "donate", "steps"},
         locks=locks,
         lock_var_hints={
             "node": "Node",

@@ -100,6 +100,22 @@ class EngineConfig:
     # ring-slot scatter is O(G*W) regardless of this value, so raising it
     # widens per-step ingestion at the cost of inbox transfer size only.
     max_entries_per_msg: int = 8
+    # Device-resident multi-step: K protocol steps per kernel launch.
+    # At K=1 (default) the engine runs the classic one-step loop,
+    # bit-identical to every release before the knob existed. At K>1 the
+    # step body runs under a lax.scan and co-hosted replica traffic
+    # (Replicate/acks/heartbeats/votes between lanes of one shared core)
+    # is routed ON DEVICE between inner steps — zero host Message objects
+    # for shared-core traffic — while host-only work (WAL save, SM apply,
+    # client notify, cross-host sends) accumulates in per-step output
+    # slots and drains once per super-step: one kernel dispatch + ONE
+    # _fetch_output device sync per K protocol steps, and one merged
+    # fsync barrier per window. Trade-off: host events (proposals,
+    # reads, ticks) enter only at super-step boundaries, so client
+    # completion latency grows with K while dispatch/fetch host wall
+    # shrinks by ~K. K must be a static int (it is compiled into the
+    # scan length); incompatible with shard_over_mesh for now.
+    steps_per_sync: int = 1
     # Pipeline the engine loop: dispatch kernel step t, then decode step
     # t-1's output while the device computes. Removes the device wait from
     # the loop's critical path (a ~2x step rate on accelerators, where the

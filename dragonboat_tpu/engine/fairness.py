@@ -92,6 +92,7 @@ class FairnessWatchdog:
         self._recent_max_s = 0.0  # windowed max
         self._recent_left = self._WINDOW
         self._iters = 0
+        self._steps = 0  # protocol steps covered by those iterations
         self._yields = 0
         self._tick_burst_max = 0
         self._tick_bursts_clamped = 0
@@ -107,13 +108,20 @@ class FairnessWatchdog:
     def iter_begin(self) -> float:
         return self._clock()
 
-    def iter_end(self, t0: float, ticks: int = 0) -> bool:
+    def iter_end(self, t0: float, ticks: int = 0, steps: int = 1) -> bool:
         """Record one loop iteration; returns True when a fairness yield
-        was enforced (the loop slept to cede CPU to a starved peer)."""
+        was enforced (the loop slept to cede CPU to a starved peer).
+        ``steps`` is how many protocol steps the iteration advanced (K
+        for a multi-step super-step): the yield decision stays
+        per-ITERATION wall time — a K-step launch that hogs the core
+        starves peers exactly like a long single step — but the stats
+        expose steps-per-iteration so a high per-iteration latency under
+        K>1 reads as amortization, not as starvation."""
         now = self._clock()
         gap = now - self._last_end
         self._last_end = now
         self._iters += 1
+        self._steps += max(steps, 1)
         if gap > self._max_gap_s:
             self._max_gap_s = gap
         if gap >= self._recent_max_s:
@@ -188,6 +196,10 @@ class FairnessWatchdog:
             "tick_bursts_clamped": self._tick_bursts_clamped,
             "fairness_yields": self._yields,
             "iterations": self._iters,
+            "protocol_steps": self._steps,
+            "steps_per_iteration": (
+                self._steps / self._iters if self._iters else 0.0
+            ),
             "co_scheduled_peers": peer_count() - (0 if self._closed else 1),
         }
 

@@ -83,7 +83,7 @@ from ..config import Config, NodeHostConfig
 from ..core.peer import PeerAddress, encode_config_change
 from ..core.rate import ENTRY_OVERHEAD_BYTES
 from ..logger import get_logger
-from ..ops.kernel import make_step_fn
+from ..ops.kernel import make_multi_step_fn, make_step_fn
 from ..ops.state import (
     MSG,
     NEED_SNAPSHOT,
@@ -98,9 +98,15 @@ from ..ops.state import (
     RaftTensors,
     init_state,
     lane_seed,
+    make_empty_inbox,
     rebase,
 )
-from ..profile import compile_watch, note_seam_sync, phase_plane
+from ..profile import (
+    compile_watch,
+    note_engine_steps,
+    note_seam_sync,
+    phase_plane,
+)
 from ..requests import LogicalClock
 from ..settings import soft
 from ..storage.kv import sync_all as _kv_sync_all
@@ -940,9 +946,28 @@ class VectorEngine:
 
             self._sharding = _shard_for
         self.clock = _SharedClock()
+        # device-resident multi-step: K protocol steps per kernel launch
+        # (EngineConfig.steps_per_sync). K=1 keeps the classic one-step
+        # loop byte-identical; K>1 runs the scanned super-step path.
+        self._multi = (
+            max(1, int(getattr(ecfg, "steps_per_sync", 1) or 1))
+            if ecfg
+            else 1
+        )
+        if self._multi > 1 and self._sharding is not None:
+            raise ValueError(
+                "steps_per_sync > 1 is not supported with shard_over_mesh: "
+                "on-device lane routing crosses shard boundaries"
+            )
         ov = getattr(ecfg, "overlap_decode", None) if ecfg else None
         if ov is None:
             ov = jax.default_backend() != "cpu"  # auto: see EngineConfig
+        if self._multi > 1:
+            # the super-step IS the pipelining: dispatch/fetch amortize
+            # over K steps, and the pack path needs the PREVIOUS fetch's
+            # residual-inbox occupancy (overlap would make it two steps
+            # stale and clobber device-routed residual rows)
+            ov = False
         self._overlap = bool(ov)
         self._pending = None  # in-flight (work, packs, StepOutput future)
         self._rebase_due = False
@@ -975,6 +1000,9 @@ class VectorEngine:
             "leader_changes": 0,  # (leader, term) transitions observed
             "elections_started": 0,  # lanes that went leaderless
             "entries_applied": 0,  # entries handed to the RSM
+            # multi-step engine: co-hosted messages routed ON DEVICE
+            # between inner steps (zero host Message objects each)
+            "msgs_routed_device": 0,
         }
         # ---- tick-fairness watchdog (ROADMAP seed flake) -----------------
         # Inter-iteration latency vs the host's tick period, a starvation
@@ -1011,6 +1039,27 @@ class VectorEngine:
         compile_watch().install().register(
             f"step_batch[g{self.kcfg.groups}]", self._step_fn
         )
+        # ---- multi-step (K>1) state --------------------------------------
+        # the device route table (lane index of the co-hosted replica
+        # behind each peer slot, -1 = host path) + window-base deltas,
+        # rebuilt on the loop thread whenever lane topology changes; the
+        # device-resident residual inbox (the last inner step's routed
+        # messages, consumed by the next super-step's inner step 0) and
+        # its fetched per-lane occupancy; and the routed-Replicate
+        # payload placements awaiting their acceptance report.
+        G = self.kcfg.groups
+        self._m_resid = np.zeros(G, np.int32)
+        self._pending_rep_copies: list = []
+        self._routes_dirty = True
+        if self._multi > 1:
+            self._multi_fn = make_multi_step_fn(self.kcfg, self._multi)
+            # no comma in the name: it becomes a Prometheus label value
+            compile_watch().register(
+                f"multi_step[g{G}.k{self._multi}]", self._multi_fn
+            )
+            self._np_route = np.full((G, self.kcfg.peers), -1, np.int32)
+            self._np_rdelta = np.zeros((G, self.kcfg.peers), np.int32)
+            self._resid = jax.device_put(make_empty_inbox(self.kcfg))
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
             self._state = jax.tree.map(
@@ -1321,11 +1370,19 @@ class VectorEngine:
             self._blocked_hosts.add(host)
         else:
             self._blocked_hosts.discard(host)
+        # multi-step: a partitioned host's lanes must drop out of the
+        # on-device routing table (its traffic falls back to the host
+        # path, where the partition drop applies)
+        self._routes_dirty = True
 
     def set_local_drop_hook(self, hook) -> None:
         """Install a chaos drop predicate over co-hosted delivery
-        (hook(message) -> True drops it). None clears."""
+        (hook(message) -> True drops it). None clears. While a hook is
+        installed the multi-step engine disables on-device routing
+        entirely: every co-hosted message must pass the hook, which only
+        the host path can evaluate."""
         self._local_drop_hook = hook
+        self._routes_dirty = True
 
     # ------------------------------------------------- host->device bridges
     def membership_changed(self, node: VectorNode) -> None:
@@ -1363,7 +1420,7 @@ class VectorEngine:
                 import traceback
 
                 traceback.print_exc()
-            wd.iter_end(t0, ticks=self._last_tick_burst)
+            wd.iter_end(t0, ticks=self._last_tick_burst, steps=self._multi)
         try:
             if self._discard_pending:
                 # crash teardown (stop(flush=False)): the un-decoded
@@ -1401,6 +1458,8 @@ class VectorEngine:
             # empty ~every step, bounded by in-flight snapshot workers
             with node._mu:
                 node._process_snapshot_status()
+        if self._multi > 1 and self._routes_dirty:
+            self._rebuild_routes()
         with self._dirty_mu:
             dirty = self._dirty
             self._dirty = set()
@@ -1448,6 +1507,11 @@ class VectorEngine:
                 # work)
                 elif bool(np.all(~act | self._m_quiesced)):
                     skip = True
+            if skip and self._m_resid.any():
+                # device-routed messages from the previous super-step's
+                # last inner step are parked in the residual inbox: they
+                # must be consumed even with no fresh host work
+                skip = False
             if skip:
                 # nothing new dispatched: the pipeline must not sit on an
                 # undecoded step indefinitely
@@ -1484,6 +1548,26 @@ class VectorEngine:
         # round-trips (per-call overhead dominates at these sizes); the
         # Inbox views and sharding pytree were built once at allocation
         prof.start()
+        if self._multi > 1:
+            # K protocol steps per launch: the route/delta planes ride
+            # the same batched transfer (small G x P arrays; rebuilt
+            # host-side only when lane topology changes)
+            inbox, tarr, route, rdelta = jax.device_put(
+                (
+                    self._host_inbox, self._ticks,
+                    self._np_route, self._np_rdelta,
+                )
+            )
+            self._state, outs, plans, self._resid, resid_count = (
+                self._multi_fn(
+                    self._state, inbox, tarr, self._resid, route, rdelta
+                )
+            )
+            prof.end("dispatch")
+            o, pl, rc = self._fetch_super(outs, plans, resid_count)
+            self._m_resid = rc
+            self._decode_super(work, packs, o, pl)
+            return
         if self._sharding is not None:
             inbox, tarr = jax.device_put(
                 (self._host_inbox, self._ticks), self._inbox_shardings
@@ -1517,6 +1601,19 @@ class VectorEngine:
         note_seam_sync()  # runtime sync audit: the ONE blessed transfer
         prof.end("fetch")
         return o
+
+    def _fetch_super(self, outs, plans, resid_count):
+        """The multi-step twin of _fetch_output: ONE consolidated
+        device->host transfer for the whole K-step super-step (the
+        stacked per-step StepOutput planes, the per-step route plans and
+        the residual-inbox occupancy ship together). This is the other
+        blessed sync seam — it fires once per K protocol steps."""
+        prof = self.profiler
+        prof.start()
+        o, pl, rc = jax.device_get((outs, plans, resid_count))
+        note_seam_sync()  # runtime sync audit: one transfer per K steps
+        prof.end("fetch")
+        return o._asdict(), pl._asdict(), np.array(rc, np.int32)
 
     def _flush_pending(self) -> None:
         pending, self._pending = self._pending, None
@@ -1602,10 +1699,16 @@ class VectorEngine:
                 self._m_last[w_gs].tolist(),
                 self._m_devfirst[w_gs].tolist(),
                 self._m_base[w_gs].tolist(),
+                # multi-step: device-routed residual messages occupy the
+                # low inbox slots of the NEXT super-step; host rows pack
+                # after them (all-zero at K=1)
+                self._m_resid[w_gs].tolist(),
             )
         else:
             cols = ()
-        for lane, g_quiesced, g_role, g_leader, g_last, g_devfirst, b in cols:
+        for (
+            lane, g_quiesced, g_role, g_leader, g_last, g_devfirst, b, g_resid,
+        ) in cols:
             node = lane.node
             g = lane.g
             lane.pack_info = {}
@@ -1640,7 +1743,7 @@ class VectorEngine:
                         key=key,
                     )
                     lane.staged_ccs.append((ce, key))
-            k = 0
+            k = g_resid
             # a quiesced lane with fresh host work gets a wake NOOP (the
             # kernel exits quiesce on any non-heartbeat inbox message; the
             # reference wakes through exitQuiesce on activity, quiesce.go)
@@ -2008,6 +2111,10 @@ class VectorEngine:
         if lane.recovering:
             return  # a restore is already in flight; the retry re-delivers
         lane.recovering = True
+        # multi-step: a recovering lane leaves the on-device routing
+        # table — routed traffic would advance kernel state the restore
+        # is about to overwrite; the host path holds its messages instead
+        self._routes_dirty = True
         # the restore ack must carry a term the sender will not drop as
         # stale; the kernel never sees this message (it is consumed host-
         # side), so remember the sender's term for the ack path
@@ -2027,9 +2134,252 @@ class VectorEngine:
 
     # --------------------------------------------------------------- decode
     def _decode(self, worked: Set[_Lane], packs, o: dict) -> None:
+        """One engine step's host fan-out (the K=1 path): the decode
+        phases run in the reference ordering over a single StepOutput.
+        The phase bodies live in the _decode_* subfunctions so the
+        multi-step super-step (_decode_super) can orchestrate the same
+        code with its masked, per-inner-step inputs."""
         self.last_output = o  # numpy snapshot for diagnostics/tools
+        note_engine_steps(1)
         prof = self.profiler
         prof.start()
+        self._decode_place(o, packs)
+        self._refresh_mirrors(o)
+        prof.end("place")
+        # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
+        prof.start()
+        self._decode_send_rep(o)
+        prof.end("send_rep")
+        # ---- phase 2: one batched fsynced write for every lane -----------
+        prof.start()
+        updates, lane_saves = build_save_updates(
+            o, self._m_base, self._lane_by_g
+        )
+        self._commit_saves(updates, lane_saves)
+        prof.end("save")
+        # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
+        prof.start()
+        self._decode_send_post(o)
+        prof.end("send_resp")
+        # ---- phase 4: hand committed entries to the RSM ------------------
+        prof.start()
+        self._decode_apply(o)
+        prof.end("apply")
+        # ---- phase 5: confirmed reads ------------------------------------
+        prof.start()
+        self._decode_reads(o)
+        prof.end("reads")
+        # ---- phase 6: maintenance ----------------------------------------
+        prof.start()
+        self._maintain(o)
+        prof.end("maintain")
+
+    def _decode_super(self, worked: Set[_Lane], packs, o: dict, pl: dict) -> None:
+        """Decode one K-step super-step (the multi-step path): the
+        host-only residue of every inner step, with device-routed
+        traffic masked out of the send/response planes and its
+        Replicate payload bytes replayed into the destination arenas.
+
+        Phase ordering across the window:
+          * place + phase-1 Replicates run per inner step IN ORDER (a
+            cross-host Replicate of step t materializes its payload
+            BEFORE step t+1's placements can conflict-truncate it);
+          * the WAL save is ONE merged wave: every inner step's updates
+            land in step order inside a single batched write + barrier,
+            so responses of EVERY inner step leave only after the
+            window's final — maximal — hard state is durable (the
+            persist-before-ack invariant holds against a state at least
+            as new as what each response reflects);
+          * post-fsync sends, RSM apply and confirmed reads then run per
+            inner step in order.
+        """
+        K = self._multi
+        steps = []
+        for t in range(K):
+            ot = {k: v[t] for k, v in o.items()}
+            plt = {k: v[t] for k, v in pl.items()}
+            steps.append((ot, plt))
+        self.last_output = steps[-1][0]
+        note_engine_steps(K)
+        prof = self.profiler
+        st = self._sstats
+        base = self._m_base
+        lane_by_g = self._lane_by_g
+        # ---- place + phase 1, per inner step in order --------------------
+        for t, (ot, plt) in enumerate(steps):
+            prof.start()
+            # routed Replicates consumed by THIS inner step: acceptance
+            # (rep_base) is in ot; the candidate plan was staged by the
+            # previous inner step (or the previous super-step's last one)
+            self._place_routed_reps(ot)
+            self._decode_place(ot, packs if t == 0 else None)
+            self._pending_rep_copies = self._routed_rep_plan(ot, plt)
+            for kind in ("rep", "vote", "hb", "tn", "resp", "rir"):
+                st["msgs_routed_device"] += int(plt[kind].sum())
+            self._mask_routed(ot, plt)
+            prof.end("place")
+            prof.start()
+            self._decode_send_rep(ot)
+            prof.end("send_rep")
+        self._refresh_mirrors(steps[-1][0])
+        # ---- phase 2: ONE merged save wave for the whole window ----------
+        prof.start()
+        updates: List[Update] = []
+        lane_saves: List[Tuple[_Lane, List[Entry], State]] = []
+        for ot, _plt in steps:
+            u, ls = build_save_updates(ot, base, lane_by_g)
+            updates.extend(u)
+            lane_saves.extend(ls)
+        self._commit_saves(updates, lane_saves)
+        prof.end("save")
+        # ---- phases 3-5 per inner step in order --------------------------
+        prof.start()
+        for ot, _plt in steps:
+            self._decode_send_post(ot)
+        prof.end("send_resp")
+        prof.start()
+        for ot, _plt in steps:
+            self._decode_apply(ot)
+        prof.end("apply")
+        prof.start()
+        for ot, plt in steps:
+            self._decode_reads(ot, skip_routed=plt["rir"])
+        prof.end("reads")
+        # ---- phase 6: maintenance on the window's final state ------------
+        prof.start()
+        self._maintain(steps[-1][0])
+        prof.end("maintain")
+
+    # ------------------------------------------------ multi-step routing
+    def _rebuild_routes(self) -> None:
+        """Recompute the on-device routing table (multi-step engine):
+        for every active lane and peer slot, the co-hosted destination
+        lane index and the window-base delta the kernel adds to
+        index-valued fields. Conservative by construction — any
+        condition the host delivery path special-cases (chaos drop
+        hook, partitioned host, stopped node, in-flight snapshot
+        restore, unknown peer) routes -1, so that traffic falls back to
+        the host path and its exact semantics."""
+        self._routes_dirty = False
+        if self._multi <= 1:
+            return
+        route = self._np_route
+        rdelta = self._np_rdelta
+        route.fill(-1)
+        rdelta.fill(0)
+        if self._local_drop_hook is not None:
+            return  # every co-hosted message must pass the chaos hook
+        P = self.kcfg.peers
+        base = self._m_base
+        blocked = self._blocked_hosts
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+            rt = dict(self._route)
+        for lane in lanes:
+            if not lane.active or lane.node.stopped:
+                continue
+            if lane.key[0] in blocked:
+                continue  # partitioned host: neither sends nor receives
+            g = lane.g
+            self_slot = lane.self_slot()
+            for p, nid in lane.rev.items():
+                if p == self_slot or p < 0 or p >= P:
+                    continue
+                dst = rt.get((lane.node.cluster_id, nid))
+                if (
+                    dst is None
+                    or not dst.active
+                    or dst.recovering
+                    or dst.node.stopped
+                    or dst.key[0] in blocked
+                ):
+                    continue
+                route[g, p] = dst.g
+                rdelta[g, p] = int(base[g] - base[dst.g])
+
+    def _routed_rep_plan(self, o: dict, plan: dict) -> list:
+        """Replay the kernel's deterministic inbox-slot assignment for
+        this step's device-routed Replicates: [(dst_g, slot, src_lane,
+        dst_lane, lo_real, hi_real)]. Replicate candidates come FIRST in
+        the kernel's kind-major candidate order, so their per-destination
+        slots are simply their rank among routed Replicates to the same
+        destination in row-major (g, p) order — exactly what np.nonzero
+        yields. The payload copy waits for the CONSUMING step's
+        acceptance report (_place_routed_reps)."""
+        rep = plan["rep"]
+        gs, ps = np.nonzero(rep)
+        if not gs.size:
+            return []
+        route = self._np_route
+        base = self._m_base
+        lane_by_g = self._lane_by_g
+        out = []
+        counts: Dict[int, int] = {}
+        cols = zip(
+            gs.tolist(),
+            route[gs, ps].tolist(),
+            base[gs].tolist(),
+            o["send_prev_index"][gs, ps].tolist(),
+            o["send_n_entries"][gs, ps].tolist(),
+        )
+        for g, d, b, prev, n in cols:
+            slot = counts.get(d, 0)
+            counts[d] = slot + 1
+            if n <= 0:
+                continue  # empty commit-refresh Replicate: no payload
+            src = lane_by_g[g]
+            dst = lane_by_g[d]
+            if src is None or dst is None:
+                continue
+            lo = b + prev + 1
+            out.append((d, slot, src, dst, lo, lo + n - 1))
+        return out
+
+    def _place_routed_reps(self, o: dict) -> None:
+        """Payload placement for device-routed Replicates consumed by
+        this inner step: the destination ACCEPTED the entries iff its
+        rep_base for the (lane, slot) the kernel routed them into is
+        nonzero — the same acceptance gate the host wire path applies
+        before placing a Replicate's entries into the arena."""
+        pend, self._pending_rep_copies = self._pending_rep_copies, []
+        if not pend:
+            return
+        lane_by_g = self._lane_by_g
+        rep_base = o["rep_base"]
+        for d, slot, src, dst, lo, hi in pend:
+            if lane_by_g[d] is not dst or not dst.active:
+                continue  # lane recycled between super-steps
+            if rep_base[d, slot] <= 0:
+                continue  # rejected (or consumed by a stale-term drop)
+            arena = dst.arena
+            sa = src.arena
+            for i in range(lo, hi + 1):
+                e = sa.get(i)
+                if e is not None:
+                    arena[e.index] = e
+
+    def _mask_routed(self, o: dict, plan: dict) -> None:
+        """Clear device-routed candidates out of the send/response
+        planes so the host fan-out only materializes Messages for
+        traffic the kernel could NOT route (cross-host, overflowed,
+        below-window). Builds new arrays — the fetched planes can be
+        read-only views."""
+        clr = (
+            np.where(plan["rep"], SEND_REPLICATE, 0)
+            | np.where(plan["vote"], SEND_VOTE_REQ, 0)
+            | np.where(plan["hb"], SEND_HEARTBEAT, 0)
+            | np.where(plan["tn"], SEND_TIMEOUT_NOW, 0)
+        )
+        o["send_flags"] = o["send_flags"] & ~clr
+        o["resp_type"] = np.where(
+            plan["resp"], np.int32(MSG.NONE), o["resp_type"]
+        )
+
+    # ----------------------------------------------------- decode phases
+    def _decode_place(self, o: dict, packs) -> None:
+        """Phase 0: payloads at device-assigned indexes (host-packed
+        rows when ``packs`` is given, plus new-leader noop entries) and
+        the per-step stats base count."""
         lane_by_g = self._lane_by_g
         base = self._m_base
         # ---- phase 0: place payloads at device-assigned indexes ----------
@@ -2120,6 +2470,16 @@ class VectorEngine:
         # plane adds ZERO numpy reductions to the step)
         st = self._sstats
         st["steps"] += 1
+
+    def _refresh_mirrors(self, o: dict) -> None:
+        """Rebind the whole-G numpy protocol mirrors from a StepOutput
+        and emit leader-change events for lanes whose (leader, term)
+        moved. The multi-step path calls this ONCE per super-step with
+        the window's final state: intermediate transitions inside the
+        window collapse into one observed change (the mirrors are a
+        per-sync snapshot plane, not a per-protocol-step event log)."""
+        lane_by_g = self._lane_by_g
+        st = self._sstats
         # ---- mirror refresh + leader-change events -----------------------
         new_leader = o["leader"]
         new_term = o["term"]
@@ -2161,27 +2521,36 @@ class VectorEngine:
                 lane.node._leader_event(lane.rev.get(lslot - 1, 0), term)
             st["leader_changes"] += lead_n
             st["elections_started"] += elect_n
-        prof.end("place")
-        # ---- phase 1: Replicate messages leave BEFORE the fsync ----------
-        prof.start()
+
+    def _decode_send_rep(self, o: dict) -> None:
+        """Phase 1: Replicate messages leave BEFORE the fsync."""
+        st = self._sstats
+        base = self._m_base
+        lane_by_g = self._lane_by_g
         rep_sends = gather_replicate_sends(
             o, base, lane_by_g, self._fetch_from_log
         )
         st["msgs_replicate"] += len(rep_sends)
         self._dispatch_sends(rep_sends)
-        prof.end("send_rep")
-        # ---- phase 2: one batched fsynced write for every lane -----------
-        prof.start()
-        updates, lane_saves = build_save_updates(o, base, lane_by_g)
+
+    def _commit_saves(self, updates, lane_saves) -> None:
+        """Phase 2: one batched fsynced write wave + log-reader mirror
+        append, in update order (a multi-step window passes every inner
+        step's updates through ONE call, so conflict-truncation rewrites
+        apply sequentially inside a single barrier)."""
         if updates:
             self._save_updates(updates, lane_saves)
         for lane, ents, state in lane_saves:
             if ents:
                 lane.node.log_reader.append(ents)
             lane.node.log_reader.set_state(state)
-        prof.end("save")
-        # ---- phase 3: post-fsync sends (votes, responses, heartbeats) ----
-        prof.start()
+
+    def _decode_send_post(self, o: dict) -> None:
+        """Phase 3: post-fsync sends (votes, responses, heartbeats) plus
+        the snapshot path for peers that fell behind the device window."""
+        st = self._sstats
+        base = self._m_base
+        lane_by_g = self._lane_by_g
         post = gather_post_sends(o, base, lane_by_g)
         st["msgs_broadcast"] += len(post)
         resp_sends = gather_resp_sends(o, base, lane_by_g)
@@ -2195,9 +2564,12 @@ class VectorEngine:
                 lane = lane_by_g[g]
                 if lane is not None:
                     self._start_catchup(lane, p, o)
-        prof.end("send_resp")
-        # ---- phase 4: hand committed entries to the RSM ------------------
-        prof.start()
+
+    def _decode_apply(self, o: dict) -> None:
+        """Phase 4: hand committed entries to the RSM task workers."""
+        st = self._sstats
+        base = self._m_base
+        lane_by_g = self._lane_by_g
         from ..rsm import Task
 
         apply_gs = np.nonzero(o["apply_from"])[0]
@@ -2259,9 +2631,14 @@ class VectorEngine:
                 self.set_task_ready(lane.key)
             st["entries_applied"] += applied_n
             st["lanes_commit_advanced"] += lanes_n
-        prof.end("apply")
-        # ---- phase 5: confirmed reads ------------------------------------
-        prof.start()
+
+    def _decode_reads(self, o: dict, skip_routed=None) -> None:
+        """Phase 5: confirmed reads. ``skip_routed`` (multi-step) marks
+        ready-queue slots whose READ_INDEX_RESP the kernel already
+        routed to the forwarding origin's co-hosted lane — the host
+        must not send a duplicate."""
+        base = self._m_base
+        lane_by_g = self._lane_by_g
         rc = o["ready_count"]
         ready_gs = np.nonzero(rc)[0]
         if ready_gs.size:
@@ -2278,13 +2655,17 @@ class VectorEngine:
                 o["ready_ctx"][sel, ris].tolist(),
                 o["ready_ctx2"][sel, ris].tolist(),
                 o["ready_index"][sel, ris].tolist(),
-                self._m_term[sel].tolist(),
+                # the confirming lane's own end-of-step term (== the
+                # refreshed _m_term mirror on the K=1 path)
+                o["term"][sel].tolist(),
             ):
                 lane = lane_by_g[g]
                 if lane is None or not lane.active:
                     continue
                 node = lane.node
                 applied_lanes[lane] = None
+                if skip_routed is not None and skip_routed[g, _slot]:
+                    continue  # kernel already routed this response
                 enc = (enc_lo, enc_hi)
                 idx = b + dev_idx
                 origin = _ctx_origin(enc_lo)
@@ -2317,11 +2698,6 @@ class VectorEngine:
                 lane.node.pending_read_indexes.applied(
                     lane.node.sm.last_applied_index()
                 )
-        prof.end("reads")
-        # ---- phase 6: maintenance ----------------------------------------
-        prof.start()
-        self._maintain(o)
-        prof.end("maintain")
 
     def _dispatch_sends(self, sends: List[Tuple["_Lane", Message]]) -> None:
         """Hand a decode phase's (lane, Message) batch to each owning
@@ -2710,6 +3086,37 @@ class VectorEngine:
                 self._m_last[g] -= d
         if delta.any():
             self._state = rebase(self._state, jnp.asarray(delta))
+            # window bases moved: the routing table's per-peer base
+            # deltas must be recomputed before the next dispatch
+            self._routes_dirty = True
+            if self._multi > 1 and self._m_resid.any():
+                # the device-resident residual inbox carries indexes in
+                # DESTINATION units: shift the index-valued fields of
+                # each parked message by its destination lane's delta
+                # (type-aware, mirroring which fields _pack_wire stages
+                # per message type). Rare path — eager device ops.
+                r = self._resid
+                d = jnp.asarray(delta)[:, None]
+                mt = r.mtype
+                idx_t = (
+                    (mt == MSG.REPLICATE)
+                    | (mt == MSG.REPLICATE_RESP)
+                    | (mt == MSG.READ_INDEX_RESP)
+                    | (mt == MSG.REQUEST_VOTE)
+                )
+                commit_t = (mt == MSG.REPLICATE) | (mt == MSG.HEARTBEAT)
+                hint_t = mt == MSG.REPLICATE_RESP
+                self._resid = r._replace(
+                    log_index=jnp.where(
+                        idx_t, r.log_index - d, r.log_index
+                    ),
+                    commit=jnp.where(
+                        commit_t, jnp.maximum(r.commit - d, 0), r.commit
+                    ),
+                    hint=jnp.where(
+                        hint_t, jnp.maximum(r.hint - d, 0), r.hint
+                    ),
+                )
 
     # ----------------------------------------------------------- reconciles
     def _apply_reconciles(self) -> None:
@@ -2748,6 +3155,7 @@ class VectorEngine:
                     lane = self._lane_of(op[1])
                     if lane is not None:
                         lane.recovering = False
+                        self._routes_dirty = True
             except Exception:
                 import traceback
 
@@ -3021,6 +3429,7 @@ class VectorEngine:
             v[key] = jnp.asarray(a)
         fn = _make_activate_fn(self.kcfg, bucket)
         self._state = fn(self._state, jnp.asarray(gi), v)
+        self._routes_dirty = True
         self._ready.set()
 
     def _logdb_state(self, node) -> State:
@@ -3067,6 +3476,20 @@ class VectorEngine:
         self._carry.discard(lane)
         self._catchups.discard(lane)
         self._snapfb.discard(lane)
+        # multi-step: the freed lane must not hand its device-routed
+        # residual rows or pending payload copies to the next tenant
+        self._m_resid[g] = 0
+        if self._multi > 1:
+            r = self._resid
+            self._resid = r._replace(
+                mtype=r.mtype.at[g].set(jnp.int32(MSG.NONE))
+            )
+            self._pending_rep_copies = [
+                c
+                for c in self._pending_rep_copies
+                if c[2] is not lane and c[3] is not lane
+            ]
+        self._routes_dirty = True
         lane.node._vec_lane = None
         with self._lanes_mu:
             self._lane_by_g[g] = None
@@ -3175,6 +3598,8 @@ class VectorEngine:
         }
         if not lane.snap_inflight:
             self._snapfb.discard(lane)
+        # the slot mapping changed: rebuild the on-device routing rows
+        self._routes_dirty = True
 
     def _reconcile_restore(self, node, ss: Snapshot) -> None:
         """An InstallSnapshot finished recovering: rebuild the lane at the
@@ -3260,6 +3685,8 @@ class VectorEngine:
         self._m_last[g] = 0
         self._m_quiesced[g] = False
         lane.recovering = False
+        # base moved + recovering cleared: recompute routes/base deltas
+        self._routes_dirty = True
         # restart/rejoin forensics: a lagging rejoiner whose log was
         # compacted past its index MUST take this path — the longhaul
         # runner and the restart tests assert on this event
@@ -3602,6 +4029,14 @@ def get_vector_engine(logdb, nh_config: NodeHostConfig) -> VectorEngineHandle:
                         "readindex_depth",
                         core.kcfg.readindex_depth,
                         want.readindex_depth,
+                    ),
+                    # the super-step length is compiled into the shared
+                    # core's executable: every co-hosted host runs at
+                    # the same K by construction
+                    (
+                        "steps_per_sync",
+                        core._multi,
+                        max(1, int(getattr(want, "steps_per_sync", 1) or 1)),
                     ),
                 )
                 if got != exp

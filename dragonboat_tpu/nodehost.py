@@ -1321,6 +1321,14 @@ class NodeHost(IMessageHandler):
             "engine_device_syncs_out_of_seam", (0, 0),
             float(sa["out_of_seam"]),
         )
+        # the multi-step engine's amortization ratio: protocol steps per
+        # blessed _fetch_output/_fetch_super transfer (~1 classic, ~K
+        # with steps_per_sync=K) — the honest denominator for the
+        # zero-out-of-seam-per-step assertion at any K
+        self.metrics.set_gauge(
+            "engine_steps_per_sync", (0, 0),
+            float(sa.get("steps_per_sync", 0.0)),
+        )
         self.metrics.set_gauge(
             "engine_compile_events_total", (0, 0),
             float(compile_watch().total),

@@ -42,6 +42,7 @@ from .state import (
     Inbox,
     KernelConfig,
     RaftTensors,
+    RoutePlan,
     StepOutput,
 )
 
@@ -1072,4 +1073,267 @@ def make_step_fn(cfg: KernelConfig, donate: bool = True):
     f = functools.partial(step_batch, cfg=cfg)
     if donate:
         return jax.jit(f, donate_argnums=(0,))
+    return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# device-resident multi-step: K protocol steps per kernel launch, with
+# co-hosted traffic routed between lanes INSIDE the kernel
+# ---------------------------------------------------------------------------
+
+
+def route_step_output(
+    s: RaftTensors,
+    out: StepOutput,
+    route: jax.Array,
+    rdelta: jax.Array,
+    cfg: KernelConfig,
+) -> Tuple[Inbox, RoutePlan]:
+    """Build the NEXT inner step's inbox from this step's outputs by
+    routing co-hosted traffic on device (the engine's try_local_deliver
+    without the host round trip).
+
+    ``route[g, p]`` is the lane index of the co-hosted replica behind
+    peer slot p of lane g (-1 = not device-routable: cross-host, blocked,
+    recovering, chaos hook installed); ``rdelta[g, p]`` is the window
+    base difference ``base[g] - base[route[g, p]]`` added to every
+    index-valued field so the destination reads indexes in ITS device
+    units (the host path converts through real indexes the same way).
+
+    Candidates are ordered kind-major (Replicate, RequestVote, Heartbeat,
+    TimeoutNow, response plane, forwarded-read responses) then row-major
+    — exactly the order the host decode dispatches them in — and a STABLE
+    sort by destination lane assigns inbox slots, so per-destination
+    arrival order matches the host message-queue path bit for bit. A
+    candidate ranked past the destination's K slots is NOT routed (its
+    RoutePlan bit stays False) and falls back to the host path, exactly
+    like a full receive queue does."""
+    G, P = s.member.shape
+    K = cfg.inbox_depth
+    E = cfg.max_entries_per_msg
+    R = cfg.readindex_depth
+    W = s.log_term.shape[1]
+    flags = out.send_flags
+    self_col = s.self_slot[:, None]
+    self_gp = jnp.broadcast_to(self_col, (G, P))
+    term_gp = jnp.broadcast_to(out.term[:, None], (G, P))
+    zero_gp = jnp.zeros((G, P), i32)
+    false_gp = jnp.zeros((G, P), bool)
+    zero_gk = jnp.zeros((G, K), i32)
+    zero_gr = jnp.zeros((G, R), i32)
+
+    has_dest = route >= 0
+    rep_want = ((flags & SEND_REPLICATE) != 0) & has_dest
+    vote_want = ((flags & SEND_VOTE_REQ) != 0) & has_dest
+    hb_want = ((flags & SEND_HEARTBEAT) != 0) & has_dest
+    tn_want = ((flags & SEND_TIMEOUT_NOW) != 0) & has_dest
+
+    # response plane: destination is the lane behind the replied-to slot.
+    # Self-addressed responses are skipped (the host path skips them too)
+    # and a below-window REPLICATE_RESP reject (its backoff hint falls
+    # under the destination leader's window base) stays host-side: the
+    # kernel cannot back off past first_index, only the host catchup path
+    # can serve that gap (see VectorEngine._below_window_reject).
+    resp_to = jnp.clip(out.resp_to, 0, P - 1)
+    resp_dest = jnp.take_along_axis(route, resp_to, axis=1)
+    resp_delta = jnp.take_along_axis(rdelta, resp_to, axis=1)
+    is_rresp = out.resp_type == MSG.REPLICATE_RESP
+    is_hbresp = out.resp_type == MSG.HEARTBEAT_RESP
+    below_window = is_rresp & out.resp_reject & (out.resp_hint + resp_delta < 0)
+    resp_want = (
+        (out.resp_type != MSG.NONE)
+        & (resp_dest >= 0)
+        & (out.resp_to != self_col)
+        & ~below_window
+    )
+
+    # confirmed forwarded reads: READ_INDEX_RESP back to the origin slot
+    # encoded in the ctx (engine/vector._ctx_origin)
+    ridx = jnp.arange(R, dtype=i32)[None, :]
+    live = (ridx < out.ready_count[:, None]) & (out.ready_ctx != 0)
+    origin = (out.ready_ctx >> 24) - 1
+    origin_cl = jnp.clip(origin, 0, P - 1)
+    rir_dest = jnp.take_along_axis(route, origin_cl, axis=1)
+    rir_delta = jnp.take_along_axis(rdelta, origin_cl, axis=1)
+    rir_want = live & (origin >= 0) & (origin != self_col) & (rir_dest >= 0)
+
+    # Replicate entry metadata comes straight from the sender's ring (the
+    # host path reads the same (term, is_cc) pairs off the arena entries)
+    e_off = jnp.arange(E, dtype=i32)[None, None, :]
+    e_idx = (out.send_prev_index + 1)[:, :, None] + e_off
+    e_live = (e_off < out.send_n_entries[:, :, None]) & rep_want[:, :, None]
+    ring_t = jnp.take_along_axis(s.log_term[:, None, :], e_idx % W, axis=2)
+    ring_cc = jnp.take_along_axis(s.log_is_cc[:, None, :], e_idx % W, axis=2)
+    rep_terms = jnp.where(e_live, ring_t, 0)
+    rep_cc = e_live & ring_cc
+
+    no_ents_gp = jnp.zeros((G, P, E), i32)
+    no_cc_gp = jnp.zeros((G, P, E), bool)
+
+    # candidate field planes, kind-major (= the host dispatch order)
+    kinds = (
+        # (want, dest, mtype, from, term, log_index, log_term, commit,
+        #  reject, hint, hint2, n_entries, entry_terms, entry_cc)
+        (
+            rep_want, route, jnp.full((G, P), MSG.REPLICATE, i32), self_gp,
+            term_gp, out.send_prev_index + rdelta, out.send_prev_term,
+            jnp.maximum(out.send_commit + rdelta, 0), false_gp, zero_gp,
+            zero_gp, out.send_n_entries, rep_terms, rep_cc,
+        ),
+        (
+            vote_want, route, jnp.full((G, P), MSG.REQUEST_VOTE, i32),
+            self_gp, term_gp, out.vote_last_index[:, None] + rdelta,
+            jnp.broadcast_to(out.vote_last_term[:, None], (G, P)), zero_gp,
+            false_gp, out.send_hint, zero_gp, zero_gp, no_ents_gp, no_cc_gp,
+        ),
+        (
+            hb_want, route, jnp.full((G, P), MSG.HEARTBEAT, i32), self_gp,
+            term_gp, zero_gp, zero_gp,
+            jnp.maximum(out.send_hb_commit + rdelta, 0), false_gp,
+            out.send_hint, out.send_hint2, zero_gp, no_ents_gp, no_cc_gp,
+        ),
+        (
+            tn_want, route, jnp.full((G, P), MSG.TIMEOUT_NOW, i32), self_gp,
+            term_gp, zero_gp, zero_gp, zero_gp, false_gp, zero_gp, zero_gp,
+            zero_gp, no_ents_gp, no_cc_gp,
+        ),
+        (
+            resp_want, resp_dest, out.resp_type,
+            jnp.broadcast_to(self_col, (G, K)),
+            out.resp_term,
+            jnp.where(is_rresp, out.resp_log_index + resp_delta, 0),
+            zero_gk, zero_gk,
+            out.resp_reject
+            & (is_rresp | (out.resp_type == MSG.REQUEST_VOTE_RESP)),
+            # per-type staging, mirroring _pack_wire: REPLICATE_RESP
+            # carries a (translated, clamped) backoff hint, HEARTBEAT_RESP
+            # the readindex ctx pair; every other response type carries
+            # neither
+            jnp.where(
+                is_rresp,
+                jnp.maximum(out.resp_hint + resp_delta, 0),
+                jnp.where(is_hbresp, out.resp_hint, 0),
+            ),
+            jnp.where(is_hbresp, out.resp_hint2, 0),
+            zero_gk, jnp.zeros((G, K, E), i32),
+            jnp.zeros((G, K, E), bool),
+        ),
+        (
+            rir_want, rir_dest, jnp.full((G, R), MSG.READ_INDEX_RESP, i32),
+            jnp.broadcast_to(self_col, (G, R)),
+            jnp.broadcast_to(out.term[:, None], (G, R)),
+            out.ready_index + rir_delta, zero_gr, zero_gr,
+            jnp.zeros((G, R), bool), out.ready_ctx, out.ready_ctx2, zero_gr,
+            jnp.zeros((G, R, E), i32), jnp.zeros((G, R, E), bool),
+        ),
+    )
+
+    def cat(col):
+        return jnp.concatenate([k[col].reshape(-1) for k in kinds])
+
+    def cat_e(col):
+        return jnp.concatenate([k[col].reshape(-1, E) for k in kinds])
+
+    dest = jnp.where(cat(0), cat(1), -1)
+    M = dest.shape[0]
+    key = jnp.where(dest >= 0, dest, G)
+    order = jnp.argsort(key, stable=True)
+    skey = key[order]
+    first = jnp.searchsorted(skey, skey, side="left").astype(i32)
+    slot = jnp.arange(M, dtype=i32) - first
+    ok = (skey < G) & (slot < K)
+    row = jnp.where(ok, skey, G)  # G = out of bounds -> dropped by scatter
+    col = jnp.where(ok, slot, 0)
+
+    def scat(init, vals):
+        return init.at[row, col].set(vals[order], mode="drop")
+
+    nxt = Inbox(
+        mtype=scat(jnp.full((G, K), MSG.NONE, i32), cat(2)),
+        from_slot=scat(jnp.zeros((G, K), i32), cat(3)),
+        term=scat(jnp.zeros((G, K), i32), cat(4)),
+        log_index=scat(jnp.zeros((G, K), i32), cat(5)),
+        log_term=scat(jnp.zeros((G, K), i32), cat(6)),
+        commit=scat(jnp.zeros((G, K), i32), cat(7)),
+        reject=scat(jnp.zeros((G, K), bool), cat(8)),
+        hint=scat(jnp.zeros((G, K), i32), cat(9)),
+        hint_high=scat(jnp.zeros((G, K), i32), cat(10)),
+        n_entries=scat(jnp.zeros((G, K), i32), cat(11)),
+        entry_terms=scat(jnp.zeros((G, K, E), i32), cat_e(12)),
+        entry_cc=scat(jnp.zeros((G, K, E), bool), cat_e(13)),
+    )
+    routed = jnp.zeros((M,), bool).at[order].set(ok)
+    gp, gk = G * P, G * K
+    plan = RoutePlan(
+        rep=routed[0:gp].reshape(G, P),
+        vote=routed[gp : 2 * gp].reshape(G, P),
+        hb=routed[2 * gp : 3 * gp].reshape(G, P),
+        tn=routed[3 * gp : 4 * gp].reshape(G, P),
+        resp=routed[4 * gp : 4 * gp + gk].reshape(G, K),
+        rir=routed[4 * gp + gk :].reshape(G, R),
+    )
+    return nxt, plan
+
+
+def multi_step_batch(
+    s: RaftTensors,
+    inbox: Inbox,
+    ticks: jax.Array,
+    resid: Inbox,
+    route: jax.Array,
+    rdelta: jax.Array,
+    cfg: KernelConfig,
+    steps: int,
+):
+    """``steps`` protocol steps in ONE kernel launch (lax.scan over the
+    step_batch body), with co-hosted traffic routed between lanes inside
+    the kernel (route_step_output) — zero host Message objects for
+    shared-core traffic, one dispatch + one fetch per super-step.
+
+    ``steps`` MUST be a static Python int (make_multi_step_fn closes over
+    it); a traced value here would rebuild the scan per distinct K.
+
+    Inner step 0 consumes ``resid`` (the previous super-step's last inner
+    step's routed messages, carried device-resident) merged with the
+    host-packed ``inbox`` — the host packs its rows at slots >=
+    resid_count, so the merge is a disjoint elementwise select. Host
+    ticks apply to inner step 0 only: one engine iteration charges
+    timers once whether it runs 1 or K protocol steps (tick counts come
+    from the host clock, so total tick throughput is unchanged).
+
+    Returns (state, stacked per-step StepOutput, stacked per-step
+    RoutePlan, residual Inbox, residual per-lane occupancy)."""
+    occ = resid.mtype != MSG.NONE
+
+    def mg(r, h):
+        m = occ
+        while m.ndim < r.ndim:
+            m = m[..., None]
+        return jnp.where(m, r, h)
+
+    inbox0 = jax.tree.map(mg, resid, inbox)
+
+    def body(carry, _):
+        st, ibx, tks = carry
+        st, out = step_batch(st, ibx, tks, cfg)
+        nxt, plan = route_step_output(st, out, route, rdelta, cfg)
+        return (st, nxt, jnp.zeros_like(tks)), (out, plan)
+
+    (s, resid_out, _), (outs, plans) = jax.lax.scan(
+        body, (s, inbox0, ticks), None, length=steps
+    )
+    resid_count = jnp.sum(resid_out.mtype != MSG.NONE, axis=1).astype(i32)
+    return s, outs, plans, resid_out, resid_count
+
+
+@functools.lru_cache(maxsize=None)
+def make_multi_step_fn(cfg: KernelConfig, steps: int, donate: bool = True):
+    """Jitted multi_step(state, inbox, ticks, resid, route, rdelta) ->
+    (state, outs, plans, resid, resid_count). ``steps`` is baked into
+    the executable as a static scan length (K is a compile-time
+    constant by design: the recompilation-hazard rules treat a traced
+    K as a finding). Cached per (cfg, steps, donate)."""
+    f = functools.partial(multi_step_batch, cfg=cfg, steps=steps)
+    if donate:
+        return jax.jit(f, donate_argnums=(0, 3))
     return jax.jit(f)

@@ -235,6 +235,23 @@ class StepOutput(NamedTuple):
     #   before staging work for a quiesced lane)
 
 
+class RoutePlan(NamedTuple):
+    """Which of a step's outbound messages were routed ON DEVICE into a
+    co-hosted destination lane's next-step inbox (multi_step_batch). The
+    host decode uses these masks to (a) skip materializing wire Messages
+    for routed traffic and (b) replay the deterministic slot assignment
+    so Replicate payload bytes land in the destination lane's arena.
+    A candidate that could not route (no co-hosted lane, inbox overflow,
+    below-window reject) stays False and falls back to the host path."""
+
+    rep: jax.Array  # bool[G,P] SEND_REPLICATE routed
+    vote: jax.Array  # bool[G,P] SEND_VOTE_REQ routed
+    hb: jax.Array  # bool[G,P] SEND_HEARTBEAT routed
+    tn: jax.Array  # bool[G,P] SEND_TIMEOUT_NOW routed
+    resp: jax.Array  # bool[G,K] response-plane slot routed
+    rir: jax.Array  # bool[G,R] confirmed forwarded-read resp routed
+
+
 def init_state(cfg: KernelConfig) -> RaftTensors:
     G, P, W, R = cfg.groups, cfg.peers, cfg.log_window, cfg.readindex_depth
     i32 = jnp.int32

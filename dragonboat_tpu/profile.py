@@ -179,12 +179,22 @@ class SyncAudit:
     itself."""
 
     # (path suffix, function name) pairs whose frames are the blessed
-    # transfer seam — mirrors analysis/targets.blessed_device_get
-    BLESSED = (("engine/vector.py", "_fetch_output"),)
+    # transfer seam — mirrors analysis/targets.blessed_device_get.
+    # _fetch_output is the classic one-step seam; _fetch_super is the
+    # multi-step engine's once-per-K-steps consolidated transfer.
+    BLESSED = (
+        ("engine/vector.py", "_fetch_output"),
+        ("engine/vector.py", "_fetch_super"),
+    )
 
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self.seam = 0  # blessed-seam transfers (note_seam_sync)
+        # protocol steps decoded (note_engine_steps): with the
+        # multi-step engine one seam sync covers K of these, so
+        # engine_steps / seam is the measured steps-per-sync ratio —
+        # the honest denominator for "zero out-of-seam syncs per step"
+        self.engine_steps = 0
         self._out: Dict[str, int] = {}
         self.installed = False
         self._orig_get = None
@@ -245,9 +255,12 @@ class SyncAudit:
     def snapshot(self) -> dict:
         with self._mu:
             sites = dict(self._out)
+        steps = self.engine_steps
         return {
             "in_seam": self.seam,
             "out_of_seam": sum(sites.values()),
+            "engine_steps": steps,
+            "steps_per_sync": round(steps / self.seam, 3) if self.seam else 0.0,
             "sites": sites,
         }
 
@@ -265,6 +278,7 @@ class SyncAudit:
         with self._mu:
             self._out.clear()
         self.seam = 0
+        self.engine_steps = 0
 
 
 def diff_sync(before: dict, after: dict) -> dict:
@@ -275,9 +289,13 @@ def diff_sync(before: dict, after: dict) -> dict:
         for s, n in after.get("sites", {}).items()
         if n - before.get("sites", {}).get(s, 0) > 0
     }
+    seam = after["in_seam"] - before["in_seam"]
+    steps = after.get("engine_steps", 0) - before.get("engine_steps", 0)
     return {
-        "in_seam": after["in_seam"] - before["in_seam"],
+        "in_seam": seam,
         "out_of_seam": after["out_of_seam"] - before["out_of_seam"],
+        "engine_steps": steps,
+        "steps_per_sync": round(steps / seam, 3) if seam > 0 else 0.0,
         "sites": sites,
     }
 
@@ -415,9 +433,17 @@ def compile_watch() -> CompileWatch:
 
 
 def note_seam_sync() -> None:
-    """The blessed ``_fetch_output`` seam's self-report: one integer add
-    per consolidated device->host transfer, always on."""
+    """The blessed ``_fetch_output``/``_fetch_super`` seams' self-report:
+    one integer add per consolidated device->host transfer, always on."""
     _sync_audit.seam += 1
+
+
+def note_engine_steps(n: int = 1) -> None:
+    """Protocol-step accounting for the seam ratio: the decode path
+    reports how many engine steps one fetch covered (1 on the classic
+    path, K on a multi-step super-step) so ``engine_steps_per_sync``
+    stays an honest per-step denominator at any K."""
+    _sync_audit.engine_steps += n
 
 
 def write_exposition(w, prefix: str = _PREFIX) -> None:
@@ -444,6 +470,7 @@ __all__ = [
     "compile_watch",
     "diff_compiles",
     "diff_sync",
+    "note_engine_steps",
     "note_seam_sync",
     "phase_plane",
     "sync_audit",

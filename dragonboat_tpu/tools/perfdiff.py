@@ -31,7 +31,14 @@ Honesty rule: a config stamped `scaled_down` (it ran fewer groups than
 its `nominal_groups` regime) is NOT comparable against a nominal run of
 the same config — the numbers measure different workloads. perfdiff
 refuses (verdict `incomparable`, exit code 2) instead of printing a
-delta that would be read as a regression or a win.
+delta that would be read as a regression or a win. The same rule shape
+covers `steps_per_sync` (a K=8 multi-step run measures a different
+engine than a K=1 run) and, at the record level, the `host` stamp: two
+records from DIFFERENT boxes (or one stamped, one of unknown
+provenance) measure hardware, not code — recalibrating one commit on
+two boxes of this repo's own trajectory showed a 1.65x throughput gap
+at identical code and shape. Two legacy records (neither stamped)
+still compare: the pre-stamp trajectory keeps diffing.
 
 Exit codes: 0 = pass, 1 = regression (with --gate), 2 = incomparable.
 
@@ -135,6 +142,24 @@ def _scaled(cfg: dict) -> bool:
     return bool(cfg.get("scaled_down"))
 
 
+def _steps_per_sync(cfg: dict) -> int:
+    """The engine's K (protocol steps per kernel launch / device sync).
+    Records that predate the stamp ran the classic one-step engine."""
+    try:
+        return int(cfg.get("steps_per_sync", 1) or 1)
+    except (TypeError, ValueError):
+        return 1
+
+
+def _host_id(rec: dict) -> Optional[str]:
+    """The record's box fingerprint (bench.py stamps hostname/cpu-count
+    plus a timed calibration spin). None = legacy record, pre-stamp."""
+    h = rec.get("host")
+    if isinstance(h, dict) and h.get("id"):
+        return str(h["id"])
+    return None
+
+
 def phase_regressed(
     old: float, new: float, threshold_pct: float, min_seconds: float
 ) -> bool:
@@ -184,6 +209,20 @@ def compare_config(
             "reasons": [
                 f"both runs scaled down, but to different group counts "
                 f"({oa} vs {na})"
+            ],
+        }
+    # ---- honesty: different steps_per_sync is a different engine ------
+    # K changes how many protocol steps one dispatch+fetch covers, so
+    # per-phase host seconds and client-visible latency measure different
+    # machines; a K=8 run "beating" a K=1 run is a config change, not a
+    # perf delta (same rule shape as the scaled-down refusal).
+    ok, nk = _steps_per_sync(old), _steps_per_sync(new)
+    if ok != nk:
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"steps_per_sync mismatch: old ran K={ok}, new ran K={nk};"
+                " per-phase deltas would compare different engines"
             ],
         }
     out: dict = {"verdict": PASS, "reasons": reasons}
@@ -260,6 +299,33 @@ def compare(
 ) -> dict:
     """Whole-record comparison over the configs present in both; the
     overall verdict is incomparable > fail > pass."""
+    # ---- honesty: different boxes measure hardware, not code ----------
+    # A per-phase/throughput delta across hosts would be read as a code
+    # regression or win; refuse up front. One-sided stamps also refuse —
+    # the unstamped record's provenance is unknown, so the delta cannot
+    # be attributed to code. Neither-stamped (two legacy records) keeps
+    # comparing: the pre-stamp trajectory loses nothing retroactively.
+    oh, nh = _host_id(old), _host_id(new)
+    if oh != nh:
+        if oh and nh:
+            reason = (
+                f"host mismatch: old ran on {oh!r}, new on {nh!r}; "
+                "deltas would measure hardware, not code"
+            )
+        else:
+            which = "old" if nh else "new"
+            reason = (
+                f"host provenance unknown: the {which} record predates "
+                "the host stamp, so deltas cannot be attributed to code "
+                "(rerun the old side on this box to compare)"
+            )
+        return {
+            "verdict": INCOMPARABLE,
+            "threshold_pct": threshold_pct,
+            "min_seconds": min_seconds,
+            "reasons": [reason],
+            "configs": {},
+        }
     oc = old.get("configs") or {}
     nc = new.get("configs") or {}
     configs: Dict[str, dict] = {}
@@ -306,6 +372,8 @@ def render(report: dict, old_name: str = "old", new_name: str = "new") -> str:
             )
         for r in c.get("reasons", []):
             lines.append(f"    ! {r}")
+    for r in report.get("reasons", []):
+        lines.append(f"  ! {r}")
     lines.append(f"verdict: {report['verdict'].upper()}")
     return "\n".join(lines)
 

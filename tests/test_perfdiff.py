@@ -144,6 +144,72 @@ def test_unwatched_lazy_compile_does_not_gate():
     assert r["verdict"] == PASS
 
 
+def test_refuses_steps_per_sync_mismatch():
+    """The K honesty rule (same shape as the scaled-down refusal): a
+    K=8 multi-step run measures a different engine than a K=1 run —
+    per-phase host seconds and client latency are not comparable, so
+    the diff refuses instead of printing a fake win/regression."""
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["steps_per_sync"] = 8
+    r = compare_config(a, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("steps_per_sync" in s for s in r["reasons"])
+    # and in reverse (new side predates the stamp -> implicit K=1)
+    r = compare_config(b, a)
+    assert r["verdict"] == INCOMPARABLE
+
+
+def test_same_steps_per_sync_stays_comparable():
+    """Two runs at the SAME K>1 diff normally (the K=8 trajectory can
+    gate against itself), and a missing stamp means the classic K=1
+    engine, comparable with an explicit K=1 stamp."""
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    a8 = json.loads(json.dumps(a))
+    a8["steps_per_sync"] = 8
+    b8 = json.loads(json.dumps(b))
+    b8["steps_per_sync"] = 8
+    assert compare_config(a8, b8)["verdict"] == PASS
+    explicit1 = json.loads(json.dumps(b))
+    explicit1["steps_per_sync"] = 1
+    assert compare_config(a, explicit1)["verdict"] == PASS
+
+
+def test_refuses_cross_host_records():
+    """The box honesty rule: two records stamped with different host
+    fingerprints measure hardware, not code — whole-record refusal
+    before any config is compared. One-sided stamps refuse too (the
+    unstamped side's provenance is unknown)."""
+    a = load_record(BASE)
+    b = json.loads(json.dumps(a))
+    a["host"] = {"id": "box-a/8cpu", "calib_s": 0.1}
+    b["host"] = {"id": "box-b/64cpu", "calib_s": 0.03}
+    r = compare(a, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("host mismatch" in s for s in r["reasons"])
+    assert "box-a/8cpu" in render(r)
+    # one-sided: legacy old vs stamped new (the r05 -> r06 seam)
+    legacy = load_record(BASE)
+    r = compare(legacy, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("provenance unknown" in s for s in r["reasons"])
+    r = compare(b, legacy)
+    assert r["verdict"] == INCOMPARABLE
+
+
+def test_same_host_and_legacy_pairs_stay_comparable():
+    """Same fingerprint diffs normally (the gate's steady state), and
+    two legacy records (neither stamped) keep comparing — the pre-stamp
+    trajectory loses nothing retroactively."""
+    a = load_record(BASE)
+    b = json.loads(json.dumps(a))
+    assert compare(a, b)["verdict"] == PASS  # legacy vs legacy
+    a["host"] = {"id": "box-a/8cpu", "calib_s": 0.1}
+    b["host"] = {"id": "box-a/8cpu", "calib_s": 0.4}  # load differs: ok
+    assert compare(a, b)["verdict"] == PASS
+
+
 def test_both_scaled_to_different_widths_incomparable():
     a = load_record(BASE)["configs"]["3"]
     b = json.loads(json.dumps(a))

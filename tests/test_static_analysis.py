@@ -303,6 +303,41 @@ def test_retrace_taint_flows_out_of_nested_blocks():
     assert _ids(got) == ["retrace/python-branch-on-traced"], got
 
 
+def test_retrace_scan_body_length_must_be_static():
+    """The multi-step engine's K (steps per kernel launch) MUST be a
+    compile-time constant: driving the scanned step body off a traced
+    length parameter rebuilds the executable per distinct K (or fails
+    at trace time). A non-static name taints and flags; the blessed
+    spelling — the `steps` static param make_multi_step_fn closes over
+    — stays clean (targets.static_param_names carries "steps")."""
+    got = _run(
+        """
+        def multi_step_batch(s, inbox, ticks, cfg, k):
+            for _ in range(k):
+                s2 = step_batch(s, inbox, ticks, cfg)
+            if k > 0:
+                pass
+        """,
+        "ops/kernel.py",
+        families=("retrace",),
+    )
+    assert _ids(got) == [
+        "retrace/python-branch-on-traced",
+        "retrace/python-branch-on-traced",
+    ], got
+    got = _run(
+        """
+        def multi_step_batch(s, inbox, ticks, cfg, steps):
+            for _ in range(steps):
+                pass
+            out = jax.lax.scan(None, s, None, length=steps)
+        """,
+        "ops/kernel.py",
+        families=("retrace",),
+    )
+    assert not _ids(got), got
+
+
 def test_retrace_jit_in_hot_function():
     got = _run(
         """
