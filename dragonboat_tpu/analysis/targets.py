@@ -37,6 +37,7 @@ STATE = "ops/state.py"
 SERVING_ADMISSION = "serving/admission.py"
 SERVING_BACKPRESSURE = "serving/backpressure.py"
 SERVING_FRONT = "serving/front.py"
+CHUNKS = "transport/chunks.py"
 
 FnKey = Tuple[str, str]  # (relpath, qualname)
 
@@ -214,6 +215,13 @@ def _default_targets() -> Targets:
             "logdb shard cache lock (state/max-index/last-batch caches)",
         ),
         LockSpec(
+            "Chunks", "_mu", 36,
+            "inbound snapshot-stream tracker (resume fences, per-stream "
+            "progress, stream counters); held across finalize's "
+            "InstallSnapshot handoff and the abort notify, both of which "
+            "take NodeHost._nodes_mu inside it",
+        ),
+        LockSpec(
             "NodeHost", "_nodes_mu", 38,
             "node registry + launch-spec table (the restart plane: "
             "stop/crash/restart_cluster all transition through it); held "
@@ -379,6 +387,20 @@ def _default_targets() -> Targets:
         SERVING_FRONT: {
             "ServingFront": {"_queues": "_mu"},
         },
+        # the streamed-install plane (ISSUE 13): the stream tracker and
+        # its resume/abort counters are mutated from transport delivery
+        # threads and the tick sweeper — a write outside _mu is exactly
+        # the torn-progress / double-count class of resume bug
+        CHUNKS: {
+            "Chunks": {
+                "_tracked": "_mu",
+                "_tick": "_mu",
+                "_resumed_streams": "_mu",
+                "_skipped_chunks": "_mu",
+                "_aborted_streams": "_mu",
+                "_completed_streams": "_mu",
+            },
+        },
     }
     return Targets(
         hot_functions=hot,
@@ -418,6 +440,7 @@ __all__ = [
     "FnKey",
     "LockSpec",
     "Targets",
+    "CHUNKS",
     "KERNEL",
     "KV",
     "LOGDB",

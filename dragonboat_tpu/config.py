@@ -35,6 +35,13 @@ class Config:
     is_observer: bool = False
     is_witness: bool = False
     quiesce: bool = False
+    # Pre-vote (Raft thesis 9.6): before a real campaign the replica runs
+    # a non-disruptive poll at term+1 — the prospective candidate's term
+    # and the voters' terms/votes stay untouched until a quorum confirms
+    # the election could be won. Stops a rejoining/partition-healed
+    # replica from bumping a stable quorum's term. Off by default: the
+    # False path is bit-identical to the pre-knob protocol.
+    pre_vote: bool = False
 
     def validate(self) -> None:
         # cf. config/config.go:176-208 Validate

@@ -42,13 +42,16 @@ MT = MessageType
 
 
 class RaftNodeState(enum.IntEnum):
-    """Replica roles; numbering matches reference raft.go:63-70."""
+    """Replica roles; numbering matches reference raft.go:63-70.
+    PRE_CANDIDATE extends the table for the pre-vote phase (thesis 9.6;
+    the reference has no pre-vote)."""
 
     FOLLOWER = 0
     CANDIDATE = 1
     LEADER = 2
     OBSERVER = 3
     WITNESS = 4
+    PRE_CANDIDATE = 5
 
 
 class Raft:
@@ -82,6 +85,7 @@ class Raft:
         self.dropped_read_indexes: List[SystemCtx] = []
         self.quiesced = False
         self.check_quorum = cfg.check_quorum
+        self.pre_vote = cfg.pre_vote
         self.tick_count = 0
         self.election_tick = 0
         self.heartbeat_tick = 0
@@ -124,6 +128,9 @@ class Raft:
 
     def is_candidate(self) -> bool:
         return self.state == RaftNodeState.CANDIDATE
+
+    def is_pre_candidate(self) -> bool:
+        return self.state == RaftNodeState.PRE_CANDIDATE
 
     def is_follower(self) -> bool:
         return self.state == RaftNodeState.FOLLOWER
@@ -433,6 +440,18 @@ class Raft:
         self._reset(term)
         self.set_leader_id(leader_id)
 
+    def become_pre_candidate(self) -> None:
+        """Enter the pre-vote poll (thesis 9.6): role and vote tallies
+        change, but term, vote and timers stay untouched — the poll must
+        be invisible to the rest of the group unless it wins."""
+        if self.is_leader():
+            raise RuntimeError("transitioning to pre-candidate from leader")
+        if self.is_observer() or self.is_witness():
+            raise RuntimeError("observer/witness cannot campaign")
+        self.state = RaftNodeState.PRE_CANDIDATE
+        self.votes = {}
+        self.set_leader_id(NO_LEADER)
+
     def become_candidate(self) -> None:
         if self.is_leader():
             raise RuntimeError("transitioning to candidate from leader")
@@ -478,6 +497,29 @@ class Raft:
         if from_ not in self.votes:
             self.votes[from_] = not rejected
         return sum(1 for v in self.votes.values() if v)
+
+    def pre_campaign(self) -> None:
+        """Run the non-disruptive pre-vote poll at term+1. Nothing about
+        this replica's durable state changes; a quorum of grants triggers
+        the real campaign()."""
+        self.become_pre_candidate()
+        prospective = self.term + 1
+        self._handle_vote_resp(self.node_id, False)
+        if self.is_single_node_quorum():
+            self.campaign()
+            return
+        for k in self.voting_members():
+            if k == self.node_id:
+                continue
+            self._send(
+                Message(
+                    term=prospective,
+                    to=k,
+                    type=MT.REQUEST_PREVOTE,
+                    log_index=self.log.last_index(),
+                    log_term=self.log.last_term(),
+                )
+            )
 
     def campaign(self) -> None:
         self.become_candidate()
@@ -598,13 +640,25 @@ class Raft:
     # -------------------------------------------------------------- dispatch
     def handle(self, m: Message) -> None:
         if not self._on_message_term_not_matched(m):
-            if m.term != 0 and self.term != m.term:
+            if (
+                m.term != 0
+                and self.term != m.term
+                and m.type not in (MT.REQUEST_PREVOTE, MT.REQUEST_PREVOTE_RESP)
+            ):
+                # pre-vote traffic legitimately carries the PROSPECTIVE
+                # term (current+1) without anyone adopting it
                 raise RuntimeError("mismatched term found")
             self._dispatch(m)
 
     def _drop_request_vote_from_high_term_node(self, m: Message) -> bool:
-        # disruption defense (paper section 6 last paragraph, thesis p42)
-        if m.type != MT.REQUEST_VOTE or not self.check_quorum or m.term <= self.term:
+        # disruption defense (paper section 6 last paragraph, thesis p42);
+        # applies to pre-vote polls identically — a live leader's lease
+        # refuses the poll the same way it refuses the vote
+        if (
+            m.type not in (MT.REQUEST_VOTE, MT.REQUEST_PREVOTE)
+            or not self.check_quorum
+            or m.term <= self.term
+        ):
             return False
         if m.hint == m.from_:
             # leader-transfer hint: let it through
@@ -619,6 +673,13 @@ class Raft:
         if self._drop_request_vote_from_high_term_node(m):
             return True
         if m.term > self.term:
+            if m.type == MT.REQUEST_PREVOTE:
+                # a poll never changes our term; grant/reject at our term
+                return False
+            if m.type == MT.REQUEST_PREVOTE_RESP and not m.reject:
+                # a granted poll echoes OUR prospective term back; the
+                # real term bump happens only in campaign()
+                return False
             leader_id = m.from_ if is_leader_message(m.type) else NO_LEADER
             if self.is_observer():
                 self.become_observer(m.term, leader_id)
@@ -628,6 +689,13 @@ class Raft:
                 self.become_follower(m.term, leader_id)
             return False
         # m.term < self.term
+        if m.type == MT.REQUEST_PREVOTE:
+            # answer a stale poll with our (higher) term so the poller
+            # abandons it and catches up (etcd MsgPreVote reject path)
+            self._send(
+                Message(to=m.from_, type=MT.REQUEST_PREVOTE_RESP, reject=True)
+            )
+            return True
         if is_leader_message(m.type) and self.check_quorum:
             # free a stuck higher-term candidate (etcd's
             # TestFreeStuckCandidateWithCheckQuorum corner case)
@@ -658,7 +726,12 @@ class Raft:
                     self.cluster_id, self.node_id, self.term
                 )
             return
-        self.campaign()
+        # leadership-transfer targets skip the poll: the transfer IS the
+        # quorum's sanction (etcd campaignTransfer)
+        if self.pre_vote and not self.is_leader_transfer_target:
+            self.pre_campaign()
+        else:
+            self.campaign()
 
     def _has_config_change_to_apply(self) -> bool:
         if self.has_not_applied_config_change is not None:
@@ -703,6 +776,30 @@ class Raft:
         else:
             resp.reject = True
         self._send(resp)
+
+    def _handle_node_request_prevote(self, m: Message) -> None:
+        """Answer a pre-vote poll (thesis 9.6): grant iff the prospective
+        term beats ours AND the poller's log is up to date. NOTHING in our
+        state changes — no term adoption, no vote, no election-timer
+        reset; that is the entire point of the phase."""
+        resp = Message(to=m.from_, type=MT.REQUEST_PREVOTE_RESP)
+        if m.term > self.term and self.log.up_to_date(m.log_index, m.log_term):
+            # grants echo the prospective term so the poller's tally is
+            # not dropped as stale
+            resp.term = m.term
+        else:
+            resp.reject = True
+        self._send(resp)
+
+    def _handle_precandidate_request_prevote_resp(self, m: Message) -> None:
+        if m.from_ in self.observers:
+            return
+        count = self._handle_vote_resp(m.from_, m.reject)
+        if count == self.quorum():
+            # the poll says the election is winnable: run the real one
+            self.campaign()
+        elif len(self.votes) - count == self.quorum():
+            self.become_follower(self.term, NO_LEADER)
 
     def _handle_node_config_change(self, m: Message) -> None:
         if m.reject:
@@ -1108,6 +1205,21 @@ _HANDLERS: Dict[RaftNodeState, Dict[MessageType, Callable]] = {
         MT.REQUEST_VOTE_RESP: Raft._handle_candidate_request_vote_resp,
         MT.ELECTION: Raft._handle_node_election,
         MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.REQUEST_PREVOTE: Raft._handle_node_request_prevote,
+        MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
+        MT.LOCAL_TICK: Raft._handle_local_tick,
+        MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
+    },
+    RaftNodeState.PRE_CANDIDATE: {
+        MT.HEARTBEAT: Raft._handle_candidate_heartbeat,
+        MT.PROPOSE: Raft._handle_candidate_propose,
+        MT.READ_INDEX: Raft._handle_candidate_read_index,
+        MT.REPLICATE: Raft._handle_candidate_replicate,
+        MT.INSTALL_SNAPSHOT: Raft._handle_candidate_install_snapshot,
+        MT.REQUEST_PREVOTE_RESP: Raft._handle_precandidate_request_prevote_resp,
+        MT.ELECTION: Raft._handle_node_election,
+        MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.REQUEST_PREVOTE: Raft._handle_node_request_prevote,
         MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
         MT.LOCAL_TICK: Raft._handle_local_tick,
         MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
@@ -1122,6 +1234,7 @@ _HANDLERS: Dict[RaftNodeState, Dict[MessageType, Callable]] = {
         MT.INSTALL_SNAPSHOT: Raft._handle_follower_install_snapshot,
         MT.ELECTION: Raft._handle_node_election,
         MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.REQUEST_PREVOTE: Raft._handle_node_request_prevote,
         MT.TIMEOUT_NOW: Raft._handle_follower_timeout_now,
         MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
         MT.LOCAL_TICK: Raft._handle_local_tick,
@@ -1139,6 +1252,7 @@ _HANDLERS: Dict[RaftNodeState, Dict[MessageType, Callable]] = {
         MT.LEADER_TRANSFER: _lw(Raft._handle_leader_transfer),
         MT.ELECTION: Raft._handle_node_election,
         MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.REQUEST_PREVOTE: Raft._handle_node_request_prevote,
         MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
         MT.LOCAL_TICK: Raft._handle_local_tick,
         MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,
@@ -1160,6 +1274,7 @@ _HANDLERS: Dict[RaftNodeState, Dict[MessageType, Callable]] = {
         MT.REPLICATE: Raft._handle_follower_replicate,
         MT.INSTALL_SNAPSHOT: Raft._handle_follower_install_snapshot,
         MT.REQUEST_VOTE: Raft._handle_node_request_vote,
+        MT.REQUEST_PREVOTE: Raft._handle_node_request_prevote,
         MT.CONFIG_CHANGE_EVENT: Raft._handle_node_config_change,
         MT.LOCAL_TICK: Raft._handle_local_tick,
         MT.SNAPSHOT_RECEIVED: Raft._handle_restore_remote,

@@ -371,6 +371,19 @@ class ExecEngine:
                 continue  # racing a concurrent close
             tick = node.clock.tick
             last = st.get("last_index", st["commit"])
+            # resident CLIENT-payload bytes in the in-memory log tier
+            # (config-change cmds excluded: protocol metadata reaches
+            # witnesses intact) — the witness-lane zero-payload probe,
+            # vector-parity key
+            try:
+                inmem = node.peer.raft.log.inmem
+                payload = sum(
+                    len(e.cmd)
+                    for e in inmem.entries
+                    if not e.is_config_change()
+                )
+            except Exception:
+                payload = 0
             out[node.cluster_id] = {
                 "node_id": st["node_id"],
                 "leader_id": st["leader_id"],
@@ -379,6 +392,8 @@ class ExecEngine:
                 "ticks_since_leader_change": max(
                     int(tick - getattr(node, "_leader_change_tick", 0)), 0
                 ),
+                "role": int(st["state"]),
+                "payload_bytes": payload,
             }
         return out
 

@@ -23,6 +23,7 @@ from ..requests import (
     ErrClusterClosed,
     ErrInvalidSession,
     ErrPayloadTooBig,
+    ErrSnapshotStreamAborted,
     ErrSystemBusy,
     ErrTimeoutTooSmall,
     LogicalClock,
@@ -126,6 +127,13 @@ class Node:
         # (parity with the vector engine's _m_leader_change_tick mirror)
         self._leader_change_tick = 0
         self._rate_limited = False  # refreshed each step (cf. node.go:1095)
+        # aborted inbound snapshot-install stream window: while fresh, ops
+        # that gate on the install fail FAST with the typed
+        # ErrSnapshotStreamAborted instead of a generic timeout. Plain
+        # GIL-atomic stamps — written from the chunk sink's notify, read
+        # on the API paths; cleared when a restore completes.
+        self._install_abort_deadline = 0.0
+        self._install_abort_hint = 0.0
         self._confirmed_applied = 0  # applied index confirmed into an Update
         self.initialized = threading.Event()
         # rsm manager
@@ -465,6 +473,33 @@ class Node:
         for h in handles:
             h.expire()
 
+    # -------------------------------------------- snapshot-stream aborts
+    def notify_install_aborted(self, retry_after_s: float) -> None:
+        """An inbound snapshot-install stream for this replica aborted
+        (receiver crash / sender failure / chunk gap): open the fail-fast
+        window. `retry_after_s` is both the window length and the hint
+        clients receive — sized by the caller to the raft snapshot-status
+        retry cadence (when a re-streamed install should have landed)."""
+        self._install_abort_hint = retry_after_s
+        self._install_abort_deadline = time.monotonic() + retry_after_s
+
+    def clear_install_aborted(self) -> None:
+        """A snapshot restore completed: the lag the aborted stream left
+        behind is gone, stop failing fast."""
+        self._install_abort_deadline = 0.0
+
+    def _check_install_aborted(self) -> None:
+        # the window opened because a stream this replica NEEDED died
+        # (retry restarts are filtered out at the chunk tracker); until a
+        # restore completes (clear_install_aborted) or the re-stream
+        # window passes, ops gated on the install fail fast with the
+        # typed, retry-hinted error — a retried op lands after the hint
+        # and succeeds whether the node recovered via the re-streamed
+        # install or via leader log replay
+        dl = self._install_abort_deadline
+        if dl and time.monotonic() < dl:
+            raise ErrSnapshotStreamAborted(self._install_abort_hint)
+
     def notify_admission(self) -> bool:
         """Serving-front first-admit wake (engine/quiesce.py contract):
         an idle quiesced group resumes ticking immediately instead of
@@ -479,6 +514,12 @@ class Node:
         return woke
 
     def read(self, timeout_ticks: int) -> RequestState:
+        # a linearizable read on a lagging replica gates on the applied
+        # index catching up to the read index — exactly what a snapshot
+        # install advances. With the install stream freshly aborted the
+        # read would burn its whole budget into ErrTimeout; fail fast
+        # with the typed, retry-hinted error instead.
+        self._check_install_aborted()
         rs = self.pending_read_indexes.read(timeout_ticks)
         s = self._req_sampler
         if s is not None and s.sample():
@@ -894,6 +935,7 @@ class Node:
                         self.peer.notify_raft_last_applied(
                             self.sm.last_applied_index()
                         )
+                self.clear_install_aborted()
         finally:
             self.ss.clear_recovering_from_snapshot()
 

@@ -277,12 +277,26 @@ class Snapshotter:
 
     # --------------------------------------------------------------- recovery
     def process_orphans(self) -> None:
-        """Sweep crashed temp dirs (cf. snapshotter.go:279-338)."""
+        """Sweep crashed temp dirs (cf. snapshotter.go:279-338).
+
+        `.receiving` dirs carrying a stream-progress record are NOT
+        orphans anymore: they are the resume state of an interrupted
+        inbound snapshot stream (transport/chunks.py) — the restarted
+        host's re-streamed install fast-forwards through the chunks they
+        already hold instead of re-transferring them. Progress-less
+        `.receiving` dirs (pre-resume-protocol leftovers, torn creates)
+        still sweep; the chunk tracker reclaims stale resumable partials
+        itself when a newer stream begins."""
         if not os.path.isdir(self._dir):
             return
         for name in os.listdir(self._dir):
-            if name.endswith(GENERATING_SUFFIX) or name.endswith(RECEIVING_SUFFIX):
-                shutil.rmtree(os.path.join(self._dir, name), ignore_errors=True)
+            path = os.path.join(self._dir, name)
+            if name.endswith(GENERATING_SUFFIX):
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.endswith(RECEIVING_SUFFIX) and not os.path.exists(
+                os.path.join(path, "stream-progress.json")
+            ):
+                shutil.rmtree(path, ignore_errors=True)
 
     def dir_path(self) -> str:
         return self._dir

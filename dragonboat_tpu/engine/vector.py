@@ -81,6 +81,7 @@ import jax.numpy as jnp
 
 from ..config import Config, NodeHostConfig
 from ..core.peer import PeerAddress, encode_config_change
+from ..core.raft import _make_metadata_entries, _make_witness_snapshot
 from ..core.rate import ENTRY_OVERHEAD_BYTES
 from ..logger import get_logger
 from ..ops.kernel import make_multi_step_fn, make_step_fn
@@ -195,6 +196,7 @@ def _make_activate_fn(cfg: KernelConfig, n: int):
             ),
             rand_timeout=s.rand_timeout.at[gi].set(v["rand_timeout"]),
             check_quorum=s.check_quorum.at[gi].set(v["check_quorum"]),
+            prevote_on=s.prevote_on.at[gi].set(v["prevote_on"]),
             first_index=s.first_index.at[gi].set(v["first_index"]),
             marker_term=s.marker_term.at[gi].set(v["marker_term"]),
             last_index=s.last_index.at[gi].set(v["last_index"]),
@@ -262,6 +264,10 @@ class VectorNode(Node):
         self._vec_addresses = list(peer_addresses)
         self._vec_lane = None  # bound by VectorEngine.add_node
         self._vec_wake_counted = False  # see notify_admission
+        # snapshot record awaiting persistence on the snapshot worker
+        # (handed off by _handle_install_snapshot; at most one in flight —
+        # lane.recovering gates re-entry)
+        self._vec_install_record = None
         return None  # no scalar Peer
 
     @property
@@ -376,8 +382,26 @@ class VectorNode(Node):
         snapshot worker; reconcile the device lane and ack the leader
         (cf. node.go:950-965 + raft.go handleInstallSnapshotMessage)."""
         try:
+            # persist the snapshot record FIRST (restart safety: the
+            # recovery below reads the image through this record) — on
+            # THIS worker thread, not the engine loop: the record write is
+            # an fsync, and a monolithic install must not stall the whole
+            # fleet's super-step cadence (see _handle_install_snapshot)
+            ss_rec = self._vec_install_record
+            self._vec_install_record = None
+            if ss_rec is not None:
+                self.logdb.save_raft_state(
+                    [
+                        Update(
+                            cluster_id=self.cluster_id,
+                            node_id=self._node_id,
+                            snapshot=ss_rec,
+                        )
+                    ]
+                )
             idx = self.sm.recover_from_snapshot(task)
             if idx > 0:
+                self.clear_install_aborted()
                 ss = self.snapshotter.get_most_recent_snapshot()
                 if ss is not None and not ss.is_empty():
                     with self._mu:
@@ -409,13 +433,22 @@ class _Arena:
     resident merely as the window's payload cache (the scalar inmem drops
     them instead, inmemory.go appliedLogTo)."""
 
-    __slots__ = ("w", "buf", "mem_bytes", "unapplied_bytes", "applied")
+    __slots__ = (
+        "w", "buf", "mem_bytes", "unapplied_bytes", "payload_bytes", "applied"
+    )
 
     def __init__(self, window: int) -> None:
         self.w = window
         self.buf: List[Optional[Entry]] = [None] * window
         self.mem_bytes = 0
         self.unapplied_bytes = 0
+        # resident CLIENT-payload bytes only (no per-entry overhead, and
+        # config-change entries excluded — their encoded membership cmd
+        # is protocol metadata that legitimately reaches witnesses
+        # intact, cf. raft.go:742-756): the witness-lane probe — a
+        # witness replica must hold ZERO of these, asserted by
+        # lane_stats/tests and the observer_witness_churn verdict
+        self.payload_bytes = 0
         self.applied = 0
 
     def __setitem__(self, key: int, entry: Entry) -> None:
@@ -425,9 +458,13 @@ class _Arena:
         if old is not None:
             osz = ENTRY_OVERHEAD_BYTES + len(old.cmd)
             self.mem_bytes -= osz
+            if old.type != EntryType.CONFIG_CHANGE:
+                self.payload_bytes -= len(old.cmd)
             if old.index > self.applied:
                 self.unapplied_bytes -= osz
         self.mem_bytes += sz
+        if entry.type != EntryType.CONFIG_CHANGE:
+            self.payload_bytes += len(entry.cmd)
         if key > self.applied:
             self.unapplied_bytes += sz
         self.buf[slot] = entry
@@ -494,6 +531,7 @@ class _Lane:
         "active",
         "cc_inflight",
         "mem_sig",
+        "wit_slots",
     )
 
     def __init__(self, g: int, node: VectorNode, key=None) -> None:
@@ -533,6 +571,11 @@ class _Lane:
         # image reconciled onto the device — config changes that restate
         # the same image (e.g. bootstrap CCs) skip the device remap
         self.mem_sig: Optional[tuple] = None
+        # peer slots holding WITNESS members: replication toward these is
+        # payload-stripped (metadata entries / witness-shaped snapshots,
+        # cf. raft.go:742-756) at every host sender site. Maintained by
+        # the same three reconcile paths that maintain mem_sig.
+        self.wit_slots: frozenset = frozenset()
 
     # ------------------------------------------------------- slot mapping
     def set_slots(self, member_ids) -> Dict[int, int]:
@@ -580,6 +623,7 @@ class _Lane:
 _RESP_WIRE = {
     int(MSG.REPLICATE_RESP): MT.REPLICATE_RESP,
     int(MSG.REQUEST_VOTE_RESP): MT.REQUEST_VOTE_RESP,
+    int(MSG.REQUEST_PREVOTE_RESP): MT.REQUEST_PREVOTE_RESP,
     int(MSG.HEARTBEAT_RESP): MT.HEARTBEAT_RESP,
     int(MSG.NOOP): MT.NOOP,
 }
@@ -646,6 +690,10 @@ def gather_replicate_sends(
                     lane.node.describe(), b + prev + 1, b + prev + n,
                 )
                 continue
+        if p in lane.wit_slots:
+            # witness peers replicate metadata only — payload bytes never
+            # leave this host toward a witness
+            ents = _make_metadata_entries(ents)
         # causal trace: a sampled entry's trace id rides the Message (and
         # the Entry codec) so the follower stamps the same key. Scanning
         # is bounded by max_entries_per_msg; only the 1-in-N sampled case
@@ -685,13 +733,15 @@ def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
     sends: List[Tuple[_Lane, Message]] = []
     send_flags = o["send_flags"]
     term_plane = o["term"]
+    role_plane = o["role"]
     gs, ps = np.nonzero(send_flags & SEND_VOTE_REQ)
     if gs.size:
-        for g, p, b, term, vli, vlt, hint in zip(
+        for g, p, b, term, role, vli, vlt, hint in zip(
             gs.tolist(),
             ps.tolist(),
             base[gs].tolist(),
             term_plane[gs].tolist(),
+            role_plane[gs].tolist(),
             o["vote_last_index"][gs].tolist(),
             o["vote_last_term"][gs].tolist(),
             o["send_hint"][gs, ps].tolist(),
@@ -700,15 +750,19 @@ def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
             if tgt is None:
                 continue
             lane, to_nid = tgt
+            # the shared vote plane serves both election phases: a
+            # PRE_CANDIDATE lane polls with REQUEST_PREVOTE at the
+            # PROSPECTIVE term (its own term stays untouched)
+            pre = role == ROLE.PRE_CANDIDATE
             sends.append(
                 (
                     lane,
                     Message(
-                        type=MT.REQUEST_VOTE,
+                        type=MT.REQUEST_PREVOTE if pre else MT.REQUEST_VOTE,
                         cluster_id=lane.node.cluster_id,
                         to=to_nid,
                         from_=lane.node.node_id(),
-                        term=term,
+                        term=term + 1 if pre else term,
                         log_index=b + vli,
                         log_term=vlt,
                         hint=hint,
@@ -1968,7 +2022,7 @@ class VectorEngine:
             return False
         if t == MT.QUIESCE:
             return False
-        from_slot = lane.slot_of(m.from_, provisional=t == MT.REPLICATE or t == MT.HEARTBEAT or t == MT.REQUEST_VOTE or t == MT.TIMEOUT_NOW or t == MT.READ_INDEX_RESP)
+        from_slot = lane.slot_of(m.from_, provisional=t == MT.REPLICATE or t == MT.HEARTBEAT or t == MT.REQUEST_VOTE or t == MT.REQUEST_PREVOTE or t == MT.TIMEOUT_NOW or t == MT.READ_INDEX_RESP)
         if from_slot < 0 and m.from_ != 0:
             return False  # unknown sender and no room to learn it
         if t == MT.REPLICATE:
@@ -2030,6 +2084,18 @@ class VectorEngine:
             self._stage_row(
                 g, k, MSG.REQUEST_VOTE_RESP, from_slot=from_slot, term=m.term,
                 reject=m.reject,
+            )
+            return True
+        if t == MT.REQUEST_PREVOTE:
+            self._stage_row(
+                g, k, MSG.REQUEST_PREVOTE, from_slot=from_slot, term=m.term,
+                log_index=m.log_index - b, log_term=m.log_term, hint=m.hint,
+            )
+            return True
+        if t == MT.REQUEST_PREVOTE_RESP:
+            self._stage_row(
+                g, k, MSG.REQUEST_PREVOTE_RESP, from_slot=from_slot,
+                term=m.term, reject=m.reject,
             )
             return True
         if t == MT.REPLICATE_RESP:
@@ -2120,16 +2186,11 @@ class VectorEngine:
         # side), so remember the sender's term for the ack path
         # (cf. raft.go:1415-1449 term preamble)
         lane.adopted_term = max(lane.adopted_term, m.term)
-        # persist the snapshot record before recovery (restart safety)
-        node.logdb.save_raft_state(
-            [
-                Update(
-                    cluster_id=lane.node.cluster_id,
-                    node_id=lane.node.node_id(),
-                    snapshot=ss,
-                )
-            ]
-        )
+        # the snapshot record is persisted (fsync) on the snapshot worker
+        # right before recovery, NOT here: this is the engine loop thread,
+        # and a monolithic install must not stall every other lane's
+        # super-step cadence (the streamed-install watchdog bound)
+        node._vec_install_record = ss
         lane.node._push_install_snapshot(ss)
 
     # --------------------------------------------------------------- decode
@@ -2284,6 +2345,12 @@ class VectorEngine:
             self_slot = lane.self_slot()
             for p, nid in lane.rev.items():
                 if p == self_slot or p < 0 or p >= P:
+                    continue
+                if p in lane.wit_slots:
+                    # witness peers stay on the host path: its senders
+                    # strip payloads to METADATA (the zero-payload
+                    # witness contract); the device route would copy
+                    # full entries into the witness arena
                     continue
                 dst = rt.get((lane.node.cluster_id, nid))
                 if (
@@ -2838,6 +2905,10 @@ class VectorEngine:
             lane.snap_inflight[p] = (self.clock.tick, 0)
             self._snapfb.add(lane)
             return
+        if p in lane.wit_slots:
+            # witnesses get a real (non-dummy) snapshot record with the
+            # data payload stripped (cf. raft.go:699-707)
+            ss = _make_witness_snapshot(ss)
         lane.node._send_message(
             Message(
                 type=MT.INSTALL_SNAPSHOT,
@@ -2912,6 +2983,9 @@ class VectorEngine:
             if to_nid is None:
                 done.append(p)
                 continue
+            if p in lane.wit_slots:
+                # host catchup honors the witness shape too
+                ents = _make_metadata_entries(ents)
             lane.node._send_message(
                 Message(
                     type=MT.REPLICATE,
@@ -3291,6 +3365,7 @@ class VectorEngine:
                 voting[slot] = True
             else:
                 voting[slot] = True
+        lane.wit_slots = frozenset(np.nonzero(witness)[0].tolist())
         role = (
             ROLE.OBSERVER if cfg.is_observer
             else ROLE.WITNESS if cfg.is_witness
@@ -3343,6 +3418,7 @@ class VectorEngine:
             heartbeat_timeout=hb,
             rand_timeout=rand_to,
             check_quorum=cfg.check_quorum,
+            prevote_on=bool(cfg.pre_vote),
             first_index=dev_first,
             marker_term=marker_term,
             last_index=dev_last,
@@ -3367,6 +3443,7 @@ class VectorEngine:
         ("heartbeat_timeout", np.int32),
         ("rand_timeout", np.int32),
         ("check_quorum", bool),
+        ("prevote_on", bool),
         ("first_index", np.int32),
         ("marker_term", np.int32),
         ("last_index", np.int32),
@@ -3541,6 +3618,7 @@ class VectorEngine:
                 voting[slot] = True
             else:
                 voting[slot] = True
+        lane.wit_slots = frozenset(np.nonzero(witness)[0].tolist())
         dev_last = int(np.asarray(s.last_index[g]))
         match = permute_row(s.match[g], 0)
         nxt = permute_row(s.next[g], dev_last + 1)
@@ -3563,6 +3641,15 @@ class VectorEngine:
         if self_slot < 0:
             self_slot = lane.slot_of(node.node_id(), provisional=True)
         new_leader = remap_ref(s.leader[g])
+        # self-promotion: an observer added as a full member becomes a
+        # follower in place, inheriting its replicated log (cf. raft.go
+        # addNode / scalar Raft.add_node become_follower path)
+        if (
+            int(self._m_role[g]) == ROLE.OBSERVER
+            and node.node_id() in mem.addresses
+        ):
+            self._m_role[g] = ROLE.FOLLOWER
+            s = s._replace(role=s.role.at[g].set(ROLE.FOLLOWER))
         upd = dict(
             member=s.member.at[g].set(jnp.asarray(member)),
             voting=s.voting.at[g].set(jnp.asarray(voting)),
@@ -3642,6 +3729,7 @@ class VectorEngine:
                 voting[slot] = True
             else:
                 voting[slot] = True
+        lane.wit_slots = frozenset(np.nonzero(witness)[0].tolist())
         self_slot = lane.self_slot()
         if self_slot < 0:
             self_slot = lane.slot_of(node.node_id(), provisional=True)
@@ -3819,6 +3907,7 @@ class VectorEngine:
         term = self._m_term
         commit = self._m_commit
         last = self._m_last
+        role = self._m_role
         chg = self._m_leader_change_tick
         tick = self.clock.tick
         for lane in lanes:
@@ -3831,6 +3920,12 @@ class VectorEngine:
                 "term": int(term[g]),
                 "commit_gap": max(int(last[g] - commit[g]), 0),
                 "ticks_since_leader_change": max(int(tick - chg[g]), 0),
+                # lane-variant probes: the replica's role (observer/witness
+                # lanes included) and resident client-payload bytes — a
+                # witness lane must report payload_bytes == 0 (the
+                # observer_witness_churn verdict and tests assert on it)
+                "role": int(role[g]),
+                "payload_bytes": lane.arena.payload_bytes,
             }
         return out
 

@@ -549,8 +549,16 @@ class NodeHost(IMessageHandler):
             self._launch_specs[cluster_id] = (
                 initial_members, join, sm_factory, cfg,
             )
-        self.engine.add_node(node)
+        # initial-snapshot recovery runs HERE, on the control-plane
+        # thread, BEFORE the engine sees the node: the vector engine's
+        # lane activation otherwise runs it on the step-loop thread, and
+        # a seconds-long SM restore (restart with a big image) would
+        # stall every co-hosted lane's step cadence — the monolithic-
+        # install stall the streamed-install plane exists to prevent.
+        # (The activation path keeps its own idempotent call as the
+        # race fallback.)
         node.recover_initial_snapshot()
+        self.engine.add_node(node)
 
     def _bootstrap_cluster(
         self, initial_members, join, cfg: Config, smtype: int
@@ -1077,6 +1085,22 @@ class NodeHost(IMessageHandler):
         else:
             for m in wire:
                 self.transport.send(m)
+
+    def _on_snapshot_stream_aborted(
+        self, cluster_id: int, node_id: int, from_: int, reason: str
+    ) -> None:
+        """Inbound install stream died (Chunks._drop): open the receiving
+        node's fail-fast window so client ops gated on the install get the
+        typed ErrSnapshotStreamAborted (+ retry-after hint) instead of a
+        generic timeout. The hint is the raft snapshot-status retry
+        cadence — when the sender's re-streamed install should have
+        landed (cf. feedback.go:38-128 / VectorEngine._run_snapshot_feedback)."""
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None or node.node_id() != node_id:
+            return
+        retry_ticks = max(4 * node.config.election_rtt, 16)
+        node.notify_install_aborted(retry_ticks * self._tick_ms / 1000.0)
 
     def _recv_chunk(self, chunk) -> bool:
         """Inbound chunk sink with the receive-side bandwidth cap: the

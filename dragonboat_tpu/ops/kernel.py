@@ -196,10 +196,16 @@ def _has_uncommitted_cc(s: RaftTensors):
     return jnp.any(live & s.log_is_cc, axis=1)
 
 
-def _campaign(s: RaftTensors, mask, out, transfer_hint) -> Tuple[RaftTensors, dict]:
+def _campaign(
+    s: RaftTensors, mask, out, transfer_hint, force_real=None
+) -> Tuple[RaftTensors, dict]:
     """Start an election on masked lanes (cf. raft.go campaign()):
     become candidate (term+1, vote self), emit RequestVote descriptors;
-    single-node quorum becomes leader instantly."""
+    single-node quorum becomes leader instantly. Lanes with prevote_on
+    first run the NON-DISRUPTIVE poll (thesis 9.6): role flips to
+    PRE_CANDIDATE and REQUEST_PREVOTE descriptors go out, but term, vote
+    and timers stay untouched — ``force_real`` (a won poll) and
+    ``transfer_hint`` (a sanctioned leadership transfer) skip the poll."""
     can = (
         mask
         & s.active
@@ -212,28 +218,44 @@ def _campaign(s: RaftTensors, mask, out, transfer_hint) -> Tuple[RaftTensors, di
         # self still a member
         & jnp.any(s.voting & _self_mask(s), axis=1)
     )
+    selfm = _self_mask(s)
+    single_now = _num_voting(s) == 1
+    pre = can & s.prevote_on & ~transfer_hint & ~single_now
+    if force_real is not None:
+        pre = pre & ~force_real
+    real = can & ~pre
+    # --- pre-vote poll: visible only in role/tally state ------------------
+    s = s._replace(
+        role=jnp.where(pre, ROLE.PRE_CANDIDATE, s.role),
+        leader=jnp.where(pre, 0, s.leader),
+        vresp=jnp.where(pre[:, None], selfm, s.vresp),
+        vgrant=jnp.where(pre[:, None], selfm, s.vgrant),
+    )
+    # --- real election ----------------------------------------------------
     ns = _reset(s, s.term + 1)
     ns = ns._replace(
-        role=jnp.where(can, ROLE.CANDIDATE, ns.role),
-        leader=jnp.where(can, 0, ns.leader),
-        vote=jnp.where(can, s.self_slot + 1, ns.vote),
-        vresp=jnp.where(can[:, None], _self_mask(s), ns.vresp),
-        vgrant=jnp.where(can[:, None], _self_mask(s), ns.vgrant),
+        role=jnp.where(real, ROLE.CANDIDATE, ns.role),
+        leader=jnp.where(real, 0, ns.leader),
+        vote=jnp.where(real, s.self_slot + 1, ns.vote),
+        vresp=jnp.where(real[:, None], selfm, ns.vresp),
+        vgrant=jnp.where(real[:, None], selfm, ns.vgrant),
     )
-    ns = _merge(can, ns, s)
+    ns = _merge(real, ns, s)
     # single voting member: leader immediately
-    single = can & (_num_voting(ns) == 1)
+    single = real & (_num_voting(ns) == 1)
     noop_at = jnp.where(single, ns.last_index + 1, 0)
     ns = _become_leader(ns, single)
-    # vote requests to all other voting members
+    # vote/pre-vote requests to all other voting members (one shared
+    # descriptor plane: the wire type and term are selected downstream
+    # from the end-of-step role — a lane is never both roles at once)
     others = ns.voting & ~_self_mask(ns)
     flags = jnp.where(
-        (can & ~single)[:, None] & others,
+        ((real & ~single) | pre)[:, None] & others,
         out["send_flags"] | SEND_VOTE_REQ,
         out["send_flags"],
     )
     hint = jnp.where(
-        (can & ~single & transfer_hint)[:, None] & others,
+        (real & ~single & transfer_hint)[:, None] & others,
         ns.self_slot[:, None] + 1,
         out["send_hint"],
     )
@@ -291,43 +313,59 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     local = mterm == 0
     higher = present & ~local & (mterm > s.term)
     lower = present & ~local & (mterm < s.term)
-    # disruption defense (raft.go:1387-1409)
+    is_pv = mtype == MSG.REQUEST_PREVOTE
+    is_pvr = mtype == MSG.REQUEST_PREVOTE_RESP
+    # disruption defense (raft.go:1387-1409); a live leader's lease
+    # refuses a pre-vote poll the same way it refuses the vote
     drop_rv = (
         higher
-        & (mtype == MSG.REQUEST_VOTE)
+        & ((mtype == MSG.REQUEST_VOTE) | is_pv)
         & s.check_quorum
         & (m["hint"] != from_slot + 1)
         & (s.leader != 0)
         & (s.election_tick < s.election_timeout)
     )
-    step_down = higher & ~drop_rv
+    # a pre-vote poll never changes our term, and a GRANTED poll response
+    # echoes our prospective term back (the real bump happens only when
+    # the poll wins and the real campaign runs)
+    step_down = higher & ~drop_rv & ~is_pv & ~(is_pvr & ~m["reject"])
     new_leader = jnp.where(_is_leader_msg(mtype), from_slot + 1, 0)
     s = _become_follower(s, step_down, mterm, jnp.where(step_down, new_leader, s.leader))
     # lower-term leader msg + check-quorum => NOOP response to free a stuck
-    # candidate (raft.go:1441-1447); everything lower-term is then dropped
+    # candidate (raft.go:1441-1447); a lower-term pre-vote poll is answered
+    # with a reject at OUR term so the poller abandons it; everything
+    # lower-term is then dropped
     noop_resp = lower & _is_leader_msg(mtype) & s.check_quorum
+    pv_stale = lower & is_pv
     dropped = lower | drop_rv
     act = present & ~dropped
 
     is_leader = s.role == ROLE.LEADER
     is_cand = s.role == ROLE.CANDIDATE
+    is_precand = s.role == ROLE.PRE_CANDIDATE
     is_obs = s.role == ROLE.OBSERVER
     is_wit = s.role == ROLE.WITNESS
     is_fol = s.role == ROLE.FOLLOWER
 
     resp_type = jnp.where(noop_resp, MSG.NOOP, MSG.NONE)
+    resp_type = jnp.where(pv_stale, MSG.REQUEST_PREVOTE_RESP, resp_type)
     resp_to = from_slot
     resp_log_index = jnp.zeros_like(mterm)
-    resp_reject = jnp.zeros_like(act)
+    resp_reject = pv_stale
     resp_hint = jnp.zeros_like(mterm)
     resp_hint2 = jnp.zeros_like(mterm)
+    # per-slot response term override (0 = stamp the lane's current term):
+    # pre-vote grants echo the poll's prospective term
+    pv_resp_term = jnp.zeros_like(mterm)
 
     selfm = _self_mask(s)
     from_onehot = jax.nn.one_hot(from_slot, P, dtype=bool)
     known_from = jnp.any(s.member & from_onehot, axis=1)
 
     # ---- RequestVote (any state) ------------------------------------------
-    rv = act & (mtype == MSG.REQUEST_VOTE) & (is_fol | is_cand | is_leader | is_wit)
+    rv = act & (mtype == MSG.REQUEST_VOTE) & (
+        is_fol | is_cand | is_precand | is_leader | is_wit
+    )
     can_grant = (s.vote == 0) | (s.vote == from_slot + 1)
     last_term = _term_at(s, s.last_index)
     utd = (m["log_term"] > last_term) | (
@@ -340,6 +378,16 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )
     resp_type = jnp.where(rv, MSG.REQUEST_VOTE_RESP, resp_type)
     resp_reject = jnp.where(rv, ~grant, resp_reject)
+
+    # ---- RequestPreVote (voting states, cf. scalar handler tables) --------
+    # grant iff the poll's prospective term beats ours AND the poller's log
+    # is up to date; NOTHING in our state changes either way (no vote, no
+    # term adoption, no election-timer reset) — that is the phase's point
+    pv = act & is_pv & (is_fol | is_cand | is_precand | is_leader | is_wit)
+    grant_pv = pv & (mterm > s.term) & utd
+    resp_type = jnp.where(pv, MSG.REQUEST_PREVOTE_RESP, resp_type)
+    resp_reject = jnp.where(pv, ~grant_pv, resp_reject)
+    pv_resp_term = jnp.where(grant_pv, mterm, pv_resp_term)
 
     # ---- RequestVoteResp (candidate) --------------------------------------
     rvr = act & (mtype == MSG.REQUEST_VOTE_RESP) & is_cand & known_from
@@ -361,6 +409,28 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     out["noop_term"] = jnp.maximum(out["noop_term"], jnp.where(win, s.term, 0))
     s = _become_follower(s, lose, s.term, jnp.zeros_like(s.leader))
 
+    # ---- RequestPreVoteResp (pre-candidate) -------------------------------
+    # same tally planes as the real election (a lane is never candidate
+    # and pre-candidate at once); a won poll runs the REAL campaign, a
+    # lost one falls back to follower at the UNCHANGED term
+    pvr = act & is_pvr & is_precand & known_from
+    first_pvr = pvr & ~jnp.any(s.vresp & from_onehot, axis=1)
+    s = s._replace(
+        vresp=jnp.where(first_pvr[:, None] & from_onehot, True, s.vresp),
+        vgrant=jnp.where(
+            first_pvr[:, None] & from_onehot, ~m["reject"][:, None], s.vgrant
+        ),
+    )
+    granted_pv = jnp.sum(s.vgrant & s.voting, axis=1).astype(i32)
+    rejected_pv = jnp.sum(s.vresp & ~s.vgrant & s.voting, axis=1).astype(i32)
+    q = _quorum(s)
+    win_pv = pvr & (granted_pv >= q)
+    lose_pv = pvr & ~win_pv & (rejected_pv >= q)
+    s, out = _campaign(
+        s, win_pv, out, jnp.zeros_like(win_pv), force_real=win_pv
+    )
+    s = _become_follower(s, lose_pv, s.term, jnp.zeros_like(s.leader))
+
     # ---- Election / TimeoutNow --------------------------------------------
     ele = act & (mtype == MSG.ELECTION)
     tno = act & (mtype == MSG.TIMEOUT_NOW) & is_fol
@@ -372,10 +442,14 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     rep_base = jnp.zeros_like(mterm)
 
     # ---- Replicate (non-leader) -------------------------------------------
-    rep = act & (mtype == MSG.REPLICATE) & (is_fol | is_obs | is_wit | is_cand)
-    # candidate at same term: a leader exists -> become follower (raft.go:1944)
+    rep = act & (mtype == MSG.REPLICATE) & (
+        is_fol | is_obs | is_wit | is_cand | is_precand
+    )
+    # (pre-)candidate at same term: a leader exists -> become follower
+    # (raft.go:1944)
+    rep_demote = rep & (is_cand | is_precand)
     s = _become_follower(
-        s, rep & is_cand, s.term, jnp.where(rep & is_cand, from_slot + 1, s.leader)
+        s, rep_demote, s.term, jnp.where(rep_demote, from_slot + 1, s.leader)
     )
     s = s._replace(
         leader=jnp.where(rep, from_slot + 1, s.leader),
@@ -437,9 +511,12 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     resp_hint = jnp.where(rej, s.last_index, resp_hint)
 
     # ---- Heartbeat (non-leader) -------------------------------------------
-    hb = act & (mtype == MSG.HEARTBEAT) & (is_fol | is_obs | is_wit | is_cand)
+    hb = act & (mtype == MSG.HEARTBEAT) & (
+        is_fol | is_obs | is_wit | is_cand | is_precand
+    )
+    hb_demote = hb & (is_cand | is_precand)
     s = _become_follower(
-        s, hb & is_cand, s.term, jnp.where(hb & is_cand, from_slot + 1, s.leader)
+        s, hb_demote, s.term, jnp.where(hb_demote, from_slot + 1, s.leader)
     )
     s = s._replace(
         leader=jnp.where(hb, from_slot + 1, s.leader),
@@ -680,9 +757,11 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )
 
     resps = {
-        "resp_type": jnp.where(act | noop_resp, resp_type, MSG.NONE),
+        "resp_type": jnp.where(act | noop_resp | pv_stale, resp_type, MSG.NONE),
         "resp_to": resp_to,
-        "resp_term": s.term,
+        # pre-vote grants echo the poll's prospective term; everything
+        # else stamps the lane's (end-of-slot) current term
+        "resp_term": jnp.where(pv_resp_term > 0, pv_resp_term, s.term),
         "resp_log_index": resp_log_index,
         "resp_reject": resp_reject,
         "resp_hint": resp_hint,
@@ -1007,7 +1086,12 @@ def step_batch(
     # planes are assembled at step end, so the end-of-step role gates them)
     leader_bits = SEND_REPLICATE | SEND_HEARTBEAT | SEND_TIMEOUT_NOW | NEED_SNAPSHOT
     end_leader = (s.role == ROLE.LEADER)[:, None]
-    end_cand = (s.role == ROLE.CANDIDATE)[:, None]
+    # the shared vote plane serves both election phases: candidates send
+    # REQUEST_VOTE, pre-candidates REQUEST_PREVOTE (type/term selected
+    # downstream from the end-of-step role)
+    end_cand = (
+        (s.role == ROLE.CANDIDATE) | (s.role == ROLE.PRE_CANDIDATE)
+    )[:, None]
     flags = out["send_flags"]
     flags = jnp.where(end_leader, flags, flags & ~leader_bits)
     flags = jnp.where(end_cand, flags, flags & ~SEND_VOTE_REQ)
@@ -1127,6 +1211,9 @@ def route_step_output(
     vote_want = ((flags & SEND_VOTE_REQ) != 0) & has_dest
     hb_want = ((flags & SEND_HEARTBEAT) != 0) & has_dest
     tn_want = ((flags & SEND_TIMEOUT_NOW) != 0) & has_dest
+    precand_gp = jnp.broadcast_to(
+        (out.role == ROLE.PRE_CANDIDATE)[:, None], (G, P)
+    )
 
     # response plane: destination is the lane behind the replied-to slot.
     # Self-addressed responses are skipped (the host path skips them too)
@@ -1181,8 +1268,12 @@ def route_step_output(
             zero_gp, out.send_n_entries, rep_terms, rep_cc,
         ),
         (
-            vote_want, route, jnp.full((G, P), MSG.REQUEST_VOTE, i32),
-            self_gp, term_gp, out.vote_last_index[:, None] + rdelta,
+            # the vote plane serves both election phases: a PRE_CANDIDATE
+            # lane's requests are REQUEST_PREVOTE at the PROSPECTIVE term
+            vote_want, route,
+            jnp.where(precand_gp, MSG.REQUEST_PREVOTE, MSG.REQUEST_VOTE),
+            self_gp, jnp.where(precand_gp, term_gp + 1, term_gp),
+            out.vote_last_index[:, None] + rdelta,
             jnp.broadcast_to(out.vote_last_term[:, None], (G, P)), zero_gp,
             false_gp, out.send_hint, zero_gp, zero_gp, no_ents_gp, no_cc_gp,
         ),
@@ -1204,7 +1295,11 @@ def route_step_output(
             jnp.where(is_rresp, out.resp_log_index + resp_delta, 0),
             zero_gk, zero_gk,
             out.resp_reject
-            & (is_rresp | (out.resp_type == MSG.REQUEST_VOTE_RESP)),
+            & (
+                is_rresp
+                | (out.resp_type == MSG.REQUEST_VOTE_RESP)
+                | (out.resp_type == MSG.REQUEST_PREVOTE_RESP)
+            ),
             # per-type staging, mirroring _pack_wire: REPLICATE_RESP
             # carries a (translated, clamped) backoff hint, HEARTBEAT_RESP
             # the readindex ctx pair; every other response type carries

@@ -64,6 +64,7 @@ class LoopbackCluster:
         witnesses: Tuple[int, ...] = (),
         observers: Tuple[int, ...] = (),
         seed: int = 1,
+        prevote: bool = False,
     ) -> None:
         self.cfg = cfg or KernelConfig(
             groups=n_groups, peers=max(n_replicas, 2), inbox_depth=8
@@ -90,6 +91,7 @@ class LoopbackCluster:
                     check_quorum=check_quorum,
                     is_observer=h in observers,
                     is_witness=h in witnesses,
+                    prevote=prevote,
                 )
             self.states.append(st)
         # pending[replica][group] = list of Msg
@@ -181,6 +183,7 @@ class LoopbackCluster:
         """Convert replica h's StepOutput into peer inbox messages."""
         cfg = self.cfg
         term = np.asarray(state.term)
+        role = np.asarray(state.role)
         ring = np.asarray(state.log_term)
         ring_cc = np.asarray(state.log_is_cc)
         W = cfg.log_window
@@ -239,10 +242,15 @@ class LoopbackCluster:
                         ),
                     )
                 if f & SEND_VOTE_REQ:
+                    # the shared vote plane: pre-candidates poll with
+                    # REQUEST_PREVOTE at the prospective term
+                    pre = int(role[g]) == ROLE.PRE_CANDIDATE
                     self._deliver(
                         h, p, g,
                         Msg(
-                            MSG.REQUEST_VOTE, from_slot=h, term=int(term[g]),
+                            MSG.REQUEST_PREVOTE if pre else MSG.REQUEST_VOTE,
+                            from_slot=h,
+                            term=int(term[g]) + 1 if pre else int(term[g]),
                             log_index=int(v_li[g]), log_term=int(v_lt[g]),
                             hint=int(hint[g, p]),
                         ),

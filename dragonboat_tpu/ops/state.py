@@ -23,13 +23,14 @@ import numpy as np
 
 class ROLE:
     """Replica roles; values match core.raft.RaftNodeState / reference
-    raft.go:63-70."""
+    raft.go:63-70 (PRE_CANDIDATE extends the table for pre-vote)."""
 
     FOLLOWER = 0
     CANDIDATE = 1
     LEADER = 2
     OBSERVER = 3
     WITNESS = 4
+    PRE_CANDIDATE = 5
 
 
 class RSTATE:
@@ -65,6 +66,8 @@ class MSG:
     READ_INDEX_RESP = 20
     LEADER_TRANSFER = 23
     TIMEOUT_NOW = 24
+    REQUEST_PREVOTE = 26
+    REQUEST_PREVOTE_RESP = 27
 
 
 # send_flags bits in StepOutput
@@ -110,6 +113,10 @@ class RaftTensors(NamedTuple):
     election_timeout: jax.Array  # i32[G] per-group config
     heartbeat_timeout: jax.Array  # i32[G]
     check_quorum: jax.Array  # bool[G]
+    # pre-vote gate (Config.pre_vote): lanes with the bit clear can never
+    # reach PRE_CANDIDATE — the False path is bit-identical to the
+    # pre-knob kernel
+    prevote_on: jax.Array  # bool[G]
     # log metadata (rebased int32 indexes)
     first_index: jax.Array  # i32[G] lowest index with term in the ring
     marker_term: jax.Array  # i32[G] term at first_index-1 (snapshot/compaction marker)
@@ -279,6 +286,7 @@ def init_state(cfg: KernelConfig) -> RaftTensors:
         election_timeout=jnp.full((G,), 10, i32),
         heartbeat_timeout=jnp.full((G,), 1, i32),
         check_quorum=f_g(),
+        prevote_on=f_g(),
         first_index=jnp.ones((G,), i32),
         marker_term=z_g(),
         last_index=z_g(),
@@ -345,6 +353,7 @@ def configure_group(
     check_quorum: bool = False,
     is_observer: bool = False,
     is_witness: bool = False,
+    prevote: bool = False,
 ) -> RaftTensors:
     """Host-side reconcile: activate lane g with the given membership.
     Rare-path (StartCluster / config change), so clarity over speed."""
@@ -385,6 +394,7 @@ def configure_group(
             + _mix(int(np.asarray(state.seed)[g]), 0, self_slot) % election_timeout
         ),
         "check_quorum": state.check_quorum.at[g].set(check_quorum),
+        "prevote_on": state.prevote_on.at[g].set(prevote),
     }
     return state._replace(**upd)
 
@@ -396,6 +406,7 @@ def configure_groups_uniform(
     election_timeout: int = 10,
     heartbeat_timeout: int = 1,
     check_quorum: bool = False,
+    prevote: bool = False,
 ) -> RaftTensors:
     """Vectorized configure for ALL lanes with identical membership shape —
     one whole-array update instead of G scalar dispatches. This is the bulk
@@ -429,6 +440,7 @@ def configure_groups_uniform(
         heartbeat_timeout=jnp.full((G,), heartbeat_timeout, jnp.int32),
         rand_timeout=jnp.asarray(rand_to),
         check_quorum=jnp.full((G,), check_quorum, bool),
+        prevote_on=jnp.full((G,), prevote, bool),
     )
 
 

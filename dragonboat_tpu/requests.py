@@ -68,6 +68,24 @@ class ErrSystemBusy(RequestError):
     retry_after_s = 0.0
 
 
+class ErrSnapshotStreamAborted(ErrSystemBusy):
+    """An inbound snapshot-install stream feeding this replica's catch-up
+    aborted mid-transfer (receiver crash, sender failure, chunk gap).
+    Client ops that gate on the install — linearizable reads waiting for
+    the applied index, any op while the group has no reachable leader —
+    fail FAST with this instead of burning their whole budget into a
+    generic ErrTimeout. Subclasses ErrSystemBusy so
+    serving.retry.call_with_retries retries it automatically, honoring
+    `retry_after_s` (sized to the raft snapshot-status retry window: when
+    the re-streamed install should have landed) as the backoff floor."""
+
+    code = "snapshot install stream aborted, retry later"
+
+    def __init__(self, retry_after_s: float = 0.0):
+        super().__init__()
+        self.retry_after_s = float(retry_after_s)
+
+
 class ErrInvalidSession(RequestError):
     code = "invalid session"
 

@@ -89,6 +89,9 @@ SCENARIOS = (
     "transfer",
     "snapshot",
     "overload",
+    "observer_witness_churn",
+    "prevote_rejoin_storm",
+    "streamed_install_under_crash",
     "none",
 )
 
@@ -180,7 +183,8 @@ def _mk_host(
         logdb_factory=logdb_factory,
         # the canonical vector shape every in-tree test uses, so the
         # longhaul smoke shares the suite's compiled kernel (max_peers=4
-        # covers the 3 members + one churn joiner)
+        # covers the 3 members + one churn joiner — churn and
+        # observer/witness churn share the one-joiner-at-a-time rule)
         engine=EngineConfig(
             kind=engine_kind, max_groups=32, max_peers=4, log_window=64
         ),
@@ -192,19 +196,33 @@ def _mk_host(
             members,
             False,
             lambda c, n: _HashKV(),
-            Config(
-                cluster_id=CLUSTER,
-                node_id=nid,
-                election_rtt=20,
-                heartbeat_rtt=4,
-                # small thresholds so snapshot-under-load AND the
-                # compacted-past-rejoiner install path both fire inside
-                # a short round
-                snapshot_entries=60,
-                compaction_overhead=10,
-            ),
+            _member_config(nid),
         )
     return nh
+
+
+def _member_config(nid: int, **overrides) -> Config:
+    """The longhaul group config. pre_vote + check_quorum are ON for the
+    whole soak (the canonical pairing): every crash/restart/partition
+    round exercises the poll phase, the leader lease refuses polls from
+    inside a live quorum, and the prevote_rejoin_storm verdict requires
+    both — without the lease a load-delayed heartbeat lets an up-to-date
+    member legally win a poll and read as a 'disturbance'."""
+    kw = dict(
+        cluster_id=CLUSTER,
+        node_id=nid,
+        election_rtt=20,
+        heartbeat_rtt=4,
+        # small thresholds so snapshot-under-load AND the
+        # compacted-past-rejoiner install path both fire inside a short
+        # round
+        snapshot_entries=60,
+        compaction_overhead=10,
+        pre_vote=True,
+        check_quorum=True,
+    )
+    kw.update(overrides)
+    return Config(**kw)
 
 
 def _find_leader(hosts, deadline_s=10.0):
@@ -276,12 +294,29 @@ class _Round:
         self.hosts: Dict[int, Optional[NodeHost]] = {}
         self.result = RoundResult(round_no=round_no, seed=seed)
         self.churn_ids: List[int] = []  # joined-and-not-yet-removed ids
+        # observer/witness churn: (node_id, kind) joined-and-not-removed;
+        # shares the one-joiner-at-a-time rule (max_peers bound) with the
+        # full-member churn scenario
+        self.ow_ids: List[tuple] = []
         self._next_churn_id = CHURN_HOST
         self._crash_gen = None
         # overload-scenario ledger folded into the round verdicts: across
-        # every burst this round, urgent ops must never shed and every
-        # bulk shed must carry a retry-after hint (serving/storm.py)
-        self._storm = {"bursts": 0, "urgent_shed": 0, "hints_ok": True}
+        # every burst this round, urgent ops must never be POLICY-shed,
+        # every bulk shed must carry a retry-after hint, and admitted
+        # urgent ops must complete within the capacity-aware budget
+        # (serving/storm.py — anchored to the round's on-box baseline)
+        self._storm = {
+            "bursts": 0, "urgent_shed": 0, "urgent_stalled": 0,
+            "hints_ok": True,
+        }
+        # observer/witness-churn ledger: joins attempted + the witness
+        # zero-payload probe (lane_stats)
+        self._ow = {"joins": 0, "witness_joins": 0, "witness_payload_ok": True}
+        # pre-vote rejoin-storm ledger: a storm is one seeded
+        # crash/restart or partition/heal of a NON-leader against the
+        # stable quorum; any leader change or stable-quorum term bump
+        # observed across it counts as a disturbance
+        self._pv = {"storms": 0, "disturbed": 0}
 
     # ------------------------------------------------------------ lifecycle
     def run(self) -> RoundResult:
@@ -487,6 +522,7 @@ class _Round:
         st = self._storm
         st["bursts"] += 1
         st["urgent_shed"] += out["urgent_shed"]
+        st["urgent_stalled"] += out["urgent_stalled"]
         st["hints_ok"] = st["hints_ok"] and out["retry_hints_ok"]
 
     def _op_churn(self) -> None:
@@ -514,7 +550,8 @@ class _Round:
                 churn_nh.stop_cluster(CLUSTER)
             except RequestError:
                 pass
-        elif not self.churn_ids:  # churn host serves one joiner at a time
+        elif not self.churn_ids and not self.ow_ids:
+            # churn host serves one joiner at a time (either flavor)
             nid = self._next_churn_id
             self._next_churn_id += 1
             lnh.sync_request_add_node(
@@ -525,15 +562,182 @@ class _Round:
             # the committed member
             self.churn_ids.append(nid)
             churn_nh.start_cluster(
-                {},
-                True,
-                lambda c, n: _HashKV(),
-                Config(
-                    cluster_id=CLUSTER, node_id=nid,
-                    election_rtt=20, heartbeat_rtt=4,
-                    snapshot_entries=60, compaction_overhead=10,
+                {}, True, lambda c, n: _HashKV(), _member_config(nid)
+            )
+
+    def _op_observer_witness_churn(self) -> None:
+        """Membership churn over the LANE VARIANTS: join a fresh node id
+        as an OBSERVER (replicates, never votes) or WITNESS (votes/acks,
+        zero payload) on the churn host, later remove it. While a witness
+        is joined, its lane_stats must report the WITNESS role and ZERO
+        resident payload bytes — the vector-scale witness contract."""
+        # draws BEFORE runtime probes (replay determinism, see _op_transfer)
+        kind = self.fp.choice("longhaul", "ow_kind", ["observer", "witness"])
+        rm = self.fp.decide("longhaul", "ow_rm", 0.4)
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        churn_nh = self.hosts.get(CHURN_HOST)
+        if leader is None or churn_nh is None:
+            return
+        lnh = self.hosts.get(leader)
+        if lnh is None:
+            return
+        if self.ow_ids and rm:
+            nid, _kind = self.ow_ids[0]
+            lnh.sync_request_delete_node(CLUSTER, nid, timeout_s=5.0)
+            self.ow_ids.pop(0)
+            try:
+                churn_nh.stop_cluster(CLUSTER)
+            except RequestError:
+                pass
+        elif not self.ow_ids and not self.churn_ids:
+            nid = self._next_churn_id
+            self._next_churn_id += 1
+            if kind == "observer":
+                lnh.sync_request_add_observer(
+                    CLUSTER, nid, f"c{CHURN_HOST}:1", timeout_s=5.0
+                )
+            else:
+                lnh.sync_request_add_witness(
+                    CLUSTER, nid, f"c{CHURN_HOST}:1", timeout_s=5.0
+                )
+            self.ow_ids.append((nid, kind))
+            self._ow["joins"] += 1
+            # witnesses cannot take snapshots (Config validation)
+            churn_nh.start_cluster(
+                {}, True, lambda c, n: _HashKV(),
+                _member_config(
+                    nid,
+                    is_observer=kind == "observer",
+                    is_witness=kind == "witness",
+                    snapshot_entries=0,
+                    compaction_overhead=0,
                 ),
             )
+            if kind == "witness":
+                self._ow["witness_joins"] += 1
+                # let the witness take some replicated traffic, then probe
+                time.sleep(0.5)
+                stats = churn_nh.engine.lane_stats().get(CLUSTER)
+                if stats is not None and stats["payload_bytes"] != 0:
+                    self._ow["witness_payload_ok"] = False
+
+    def _op_prevote_rejoin_storm(self) -> None:
+        """The rejoin-storm verdict op: take a NON-leader member down
+        (node crash/restart or partition/heal), long enough for its
+        election timer to fire repeatedly, and measure the STABLE
+        quorum across it. With pre_vote on (the soak config) the
+        rejoiner's polls are rejected (its log lags live traffic) and
+        its term never inflates — zero leader changes, zero term bumps
+        on the stable pair."""
+        # draws first (replay determinism)
+        pick = self.fp.choice("longhaul", "pv_victim", list(HOSTS))
+        mode = self.fp.choice("longhaul", "pv_mode", ["partition", "crash"])
+        down = self.fp.uniform("longhaul", "pv_down", 0.4, 0.9)
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        if leader is None:
+            return
+        victim = pick if pick != leader else HOSTS[pick % len(HOSTS)]
+        if victim == leader:
+            return
+        stable = [h for h in HOSTS if h != victim]
+        before = self._quorum_terms(stable)
+        if before is None:
+            return
+        nh = self.hosts.get(victim)
+        if nh is None:
+            return
+        if mode == "partition":
+            nh.set_partitioned(True)
+            time.sleep(down)
+            nh2 = self.hosts.get(victim)
+            if nh2 is not None:
+                nh2.set_partitioned(False)
+        else:
+            try:
+                nh.crash_cluster(CLUSTER)
+            except RequestError:
+                return
+            time.sleep(down)
+            nh2 = self.hosts.get(victim)
+            if nh2 is not None:
+                nh2.restart_cluster(CLUSTER)
+        # give the rejoiner a beat to land its first poll/heartbeat
+        time.sleep(0.3)
+        after = self._quorum_terms(stable)
+        self._pv["storms"] += 1
+        leader_after = _find_leader(self.hosts, deadline_s=3.0)
+        if (
+            after is None
+            or after != before
+            or leader_after != leader
+        ):
+            self._pv["disturbed"] += 1
+            flight_recorder().record(
+                "prevote_disturbance", victim=victim, mode=mode,
+                before=str(before), after=str(after),
+                leader_before=leader, leader_after=leader_after,
+            )
+
+    def _quorum_terms(self, hosts_ids) -> Optional[dict]:
+        out = {}
+        for h in hosts_ids:
+            nh = self.hosts.get(h)
+            if nh is None:
+                return None
+            stats = nh.engine.lane_stats().get(CLUSTER)
+            if stats is None:
+                return None
+            out[h] = stats["term"]
+        return out
+
+    def _op_streamed_install_under_crash(self) -> None:
+        """Drive the chunked-install path under crash: a member node goes
+        down, the leader snapshots + compacts past it (so rejoin NEEDS an
+        install, not log replay), and — on the seeded half — the victim
+        HOST is crashed while the stream is landing, restarted, and the
+        re-streamed install resumes from the receiver's recorded offset
+        (transport/chunks.py). Correctness rides the round verdicts
+        (lincheck/convergence/fairness); the deterministic offset-resume
+        assertion lives in tests/test_streamed_install.py."""
+        pick = self.fp.choice("longhaul", "si_victim", list(HOSTS))
+        crash_mid = self.fp.decide("longhaul", "si_crash", 0.5)
+        mid_delay = self.fp.uniform("longhaul", "si_delay", 0.05, 0.25)
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        if leader is None:
+            return
+        victim = pick if pick != leader else HOSTS[pick % len(HOSTS)]
+        if victim == leader:
+            return
+        vnh = self.hosts.get(victim)
+        lnh = self.hosts.get(leader)
+        if vnh is None or lnh is None:
+            return
+        try:
+            vnh.crash_cluster(CLUSTER)
+        except RequestError:
+            return
+        # let live client traffic run past the snapshot threshold, then
+        # force a snapshot so compaction passes the victim's index
+        time.sleep(0.4)
+        try:
+            lnh.sync_request_snapshot(CLUSTER, timeout_s=5.0)
+        except RequestError:
+            pass
+        if crash_mid:
+            # restart the node so the install stream starts, then kill
+            # the whole receiving HOST mid-stream; the restarted host's
+            # chunk tracker resumes from the recorded offset
+            vnh.restart_cluster(CLUSTER)
+            time.sleep(mid_delay)
+            self.hosts[victim] = None
+            vnh.crash()
+            time.sleep(0.1)
+            self.hosts[victim] = _mk_host(
+                victim, self.reg, self.dir, self.opts.engine, self.fp
+            )
+        else:
+            vnh.restart_cluster(CLUSTER)
+        time.sleep(0.3)
 
     # ------------------------------------------------------------- verdicts
     def _settle(self) -> None:
@@ -550,15 +754,19 @@ class _Round:
             nh.transport.set_pre_send_batch_hook(None)
             if not nh.has_node(CLUSTER):
                 nh.restart_cluster(CLUSTER)
-        # remove any still-joined churn member (best effort with retries:
+        # remove any still-joined churn member — full members AND
+        # observer/witness joiners — (best effort with retries:
         # leadership can still be settling right after the fault phase)
         deadline = time.monotonic() + 30
-        while self.churn_ids and time.monotonic() < deadline:
+        while (self.churn_ids or self.ow_ids) and time.monotonic() < deadline:
             leader = _find_leader(self.hosts, deadline_s=10.0)
             if leader is None:
                 continue
             try:
-                nid = self.churn_ids[0]
+                if self.churn_ids:
+                    nid = self.churn_ids[0]
+                else:
+                    nid = self.ow_ids[0][0]
                 try:
                     self.hosts[leader].sync_request_delete_node(
                         CLUSTER, nid, timeout_s=5.0
@@ -568,9 +776,16 @@ class _Round:
                     # committed already: rejected/failed retries of an
                     # already-removed member count as shed
                     m = self.hosts[leader].get_cluster_membership(CLUSTER)
-                    if nid in m.addresses:
+                    if (
+                        nid in m.addresses
+                        or nid in m.observers
+                        or nid in m.witnesses
+                    ):
                         raise
-                self.churn_ids.pop(0)
+                if self.churn_ids:
+                    self.churn_ids.pop(0)
+                else:
+                    self.ow_ids.pop(0)
                 churn_nh = self.hosts.get(CHURN_HOST)
                 if churn_nh is not None and churn_nh.has_node(CLUSTER):
                     churn_nh.stop_cluster(CLUSTER)
@@ -638,8 +853,21 @@ class _Round:
         # across every burst, zero urgent-class ops shed and every bulk
         # shed carried a machine-readable retry-after hint
         if self._storm["bursts"]:
+            # POLICY sheds only (load-caused slow completions are judged
+            # by the capacity-aware budget below — the PR 9 gate's
+            # load-sensitive failures were exactly this conflation)
             v["overload_no_urgent_shed"] = self._storm["urgent_shed"] == 0
+            v["overload_urgent_served"] = self._storm["urgent_stalled"] == 0
             v["overload_hints_ok"] = self._storm["hints_ok"]
+        # observer/witness churn (only when the scenario joined anyone):
+        # a joined witness must never hold payload bytes
+        if self._ow["witness_joins"]:
+            v["ow_witness_zero_payload"] = self._ow["witness_payload_ok"]
+        # pre-vote rejoin storms: a NON-leader member's crash/partition
+        # rejoin must not disturb the stable quorum (zero leader changes,
+        # zero term bumps) — the pre-vote acceptance verdict
+        if self._pv["storms"]:
+            v["prevote_no_disturbance"] = self._pv["disturbed"] == 0
 
     # ------------------------------------------------------------ artifacts
     def _bundle_failure(self) -> None:

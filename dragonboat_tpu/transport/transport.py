@@ -51,6 +51,8 @@ URGENT_TYPES = frozenset(
         MessageType.HEARTBEAT_RESP,
         MessageType.REQUEST_VOTE,
         MessageType.REQUEST_VOTE_RESP,
+        MessageType.REQUEST_PREVOTE,
+        MessageType.REQUEST_PREVOTE_RESP,
         MessageType.TIMEOUT_NOW,
     }
 )

@@ -52,9 +52,17 @@ class MessageType(enum.IntEnum):
     LEADER_TRANSFER = 23
     TIMEOUT_NOW = 24
     RATE_LIMIT = 25
+    # Pre-vote phase (Raft thesis 9.6 / the Paxos-Raft-parallels catalog's
+    # standard fix for rejoin-induced leader disturbance; no referent in
+    # the reference dragonboat, numbering continues past its table). A
+    # REQUEST_PREVOTE carries the PROSPECTIVE term (current+1) and never
+    # changes the receiver's term or vote; a granted REQUEST_PREVOTE_RESP
+    # echoes that prospective term back.
+    REQUEST_PREVOTE = 26
+    REQUEST_PREVOTE_RESP = 27
 
 
-NUM_MESSAGE_TYPES = 26
+NUM_MESSAGE_TYPES = 28
 
 # Message types generated locally and never put on the wire
 # (cf. raftpb/raft.go IsLocalMessageType).
@@ -75,6 +83,7 @@ _RESPONSE_TYPES = frozenset(
     {
         MessageType.REPLICATE_RESP,
         MessageType.REQUEST_VOTE_RESP,
+        MessageType.REQUEST_PREVOTE_RESP,
         MessageType.HEARTBEAT_RESP,
         MessageType.READ_INDEX_RESP,
         MessageType.UNREACHABLE,

@@ -193,3 +193,53 @@ def test_seed_matrix_drop_partition_converges(tmp_path, seed):
     finally:
         for nh in hosts.values():
             nh.stop()
+
+
+@pytest.mark.chaos
+def test_rejoin_plane_scenario_family(tmp_path):
+    """The rejoin-without-disruption scenario family in the `-m chaos`
+    matrix: one seeded longhaul round restricted to
+    observer_witness_churn / prevote_rejoin_storm /
+    streamed_install_under_crash, with the round's full verdict set
+    (lincheck, convergence, fairness, plus the scenario verdicts:
+    prevote_no_disturbance, ow_witness_zero_payload) asserted green.
+    Replay any failure by pinning CHAOS_SEED."""
+    from dragonboat_tpu.tools.longhaul import Options, run_longhaul
+
+    seed = int(os.environ.get("CHAOS_SEED", "0") or "0", 0) or 0x5EED13
+    print(f"CHAOS SEED={seed:#x} (replay: CHAOS_SEED={seed:#x} pytest -m chaos)")
+    report = run_longhaul(
+        Options(
+            budget_s=40.0,
+            rounds_max=1,
+            round_s=6.0,
+            engine="vector",
+            out_dir=str(tmp_path / "lh"),
+            seed=seed,
+            rotate=False,
+            ring=False,
+            scenarios=(
+                "observer_witness_churn",
+                "prevote_rejoin_storm",
+                "streamed_install_under_crash",
+                "none",
+            ),
+        )
+    )
+    rounds = report["rounds"]
+    assert rounds, "no round ran"
+    res = rounds[0]
+    assert res.ok, (
+        f"seed {seed:#x} verdicts="
+        f"{sorted(k for k, v in res.verdicts.items() if not v)} "
+        f"error={res.error} bundle={res.bundle}"
+    )
+    # the family actually fired
+    assert sum(
+        res.scenarios.get(k, 0)
+        for k in (
+            "observer_witness_churn",
+            "prevote_rejoin_storm",
+            "streamed_install_under_crash",
+        )
+    ) > 0, res.scenarios

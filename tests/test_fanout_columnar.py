@@ -134,6 +134,11 @@ def _random_output(rng: random.Random, lane_by_g, base):
         "vote_last_term": i32((G,), 0, 5),
         "term": i32((G,), 1, 6),
         "vote": i32((G,), 0, P),
+        # end-of-step role plane: the vote kind selects its wire type and
+        # term from it (PRE_CANDIDATE lanes poll at the prospective term)
+        "role": np.asarray(
+            [rng.choice((0, 1, 2, 5)) for _ in range(G)], np.int32
+        ),
         "resp_type": np.zeros((G, K), np.int32),
         "resp_to": i32((G, K), 0, P - 1),
         "resp_term": i32((G, K), 1, 6),
@@ -225,10 +230,13 @@ def _ref_post(o, base, lane_by_g):
             if to_nid is None:
                 continue
             if mk == "vote":
+                # pre-candidate lanes poll: REQUEST_PREVOTE at term+1
+                pre = int(o["role"][g]) == 5
                 m = Message(
-                    type=MT.REQUEST_VOTE, cluster_id=lane.node.cluster_id,
+                    type=MT.REQUEST_PREVOTE if pre else MT.REQUEST_VOTE,
+                    cluster_id=lane.node.cluster_id,
                     to=to_nid, from_=lane.node.node_id(),
-                    term=int(o["term"][g]),
+                    term=int(o["term"][g]) + 1 if pre else int(o["term"][g]),
                     log_index=int(base[g]) + int(o["vote_last_index"][g]),
                     log_term=int(o["vote_last_term"][g]),
                     hint=int(o["send_hint"][g, p]),

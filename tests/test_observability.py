@@ -451,7 +451,11 @@ def test_scalar_engine_lane_stats_parity(tmp_path):
             "term",
             "commit_gap",
             "ticks_since_leader_change",
+            "role",
+            "payload_bytes",
         }
+        assert s["role"] == 2  # this single node leads
+        assert s["payload_bytes"] >= 0
         assert s["node_id"] == 1
         assert s["leader_id"] == 1
         assert s["term"] >= 1

@@ -1,0 +1,367 @@
+"""Pre-vote (Raft thesis 9.6): the non-disruptive election poll.
+
+Three layers:
+
+  * scalar-core conformance — the poll changes NOTHING on either side
+    (no term adoption, no vote, no timer reset), grants echo the
+    prospective term, stale polls teach the poller the higher term, the
+    check-quorum lease refuses polls like votes, and a transfer target
+    skips the poll;
+  * kernel differential — the vectorized kernel with prevote ON agrees
+    with the scalar oracle replica-for-replica across seeded randomized
+    fault schedules (prevote OFF equivalence is carried by the whole
+    pre-existing differential suite, which runs the same kernel with the
+    gate cleared);
+  * the rejoin-storm verdict — an isolated/rejoining replica must cause
+    ZERO leader changes and ZERO term bumps in the stable quorum with
+    pre-vote on, and the SAME schedule reproduces the disturbance with
+    it off.
+"""
+import numpy as np
+import pytest
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.core.logentry import InMemLogDB
+from dragonboat_tpu.core.raft import Raft, RaftNodeState
+from dragonboat_tpu.core.remote import Remote
+from dragonboat_tpu.ops.loopback import LoopbackCluster
+from dragonboat_tpu.ops.state import ROLE, _mix
+from dragonboat_tpu.types import Entry, Message, MessageType as MT, is_local_message
+
+N = 3
+ELECTION = 10
+HEARTBEAT = 2
+
+
+def mk_raft(nid, pre_vote=True, check_quorum=False, full=(1, 2, 3)):
+    r = Raft(
+        Config(
+            node_id=nid, cluster_id=1, election_rtt=ELECTION,
+            heartbeat_rtt=HEARTBEAT, pre_vote=pre_vote,
+            check_quorum=check_quorum,
+        ),
+        InMemLogDB(),
+    )
+    for p in full:
+        r.remotes[p] = Remote(next=1)
+    return r
+
+
+class TestScalarPreVote:
+    def test_poll_does_not_touch_term_or_vote(self):
+        r = mk_raft(1)
+        r.handle(Message(type=MT.ELECTION, from_=1))
+        assert r.is_pre_candidate()
+        assert r.term == 0 and r.vote == 0
+        polls = [m for m in r.msgs if m.type == MT.REQUEST_PREVOTE]
+        assert len(polls) == 2  # both peers
+        assert all(m.term == r.term + 1 for m in polls)
+
+    def test_voter_grants_without_state_change(self):
+        v = mk_raft(2)
+        v.term = 4
+        v.election_tick = 3
+        v.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=2, term=5,
+                    log_index=100, log_term=100)
+        )
+        # grant echoed at the PROSPECTIVE term; nothing else moved
+        resp = [m for m in v.msgs if m.type == MT.REQUEST_PREVOTE_RESP]
+        assert len(resp) == 1 and not resp[0].reject and resp[0].term == 5
+        assert v.term == 4 and v.vote == 0 and v.election_tick == 3
+
+    def test_voter_rejects_stale_log(self):
+        v = mk_raft(2)
+        v.term = 4
+        v.log.append([Entry(term=4, index=1)])
+        v.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=2, term=5,
+                    log_index=0, log_term=0)
+        )
+        resp = [m for m in v.msgs if m.type == MT.REQUEST_PREVOTE_RESP]
+        assert len(resp) == 1 and resp[0].reject
+
+    def test_stale_poll_teaches_higher_term(self):
+        """A poll below the receiver's term is rejected AT the receiver's
+        term; the poller adopts it and abandons the poll."""
+        v = mk_raft(2)
+        v.term = 9
+        v.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=2, term=5,
+                    log_index=0, log_term=0)
+        )
+        resp = [m for m in v.msgs if m.type == MT.REQUEST_PREVOTE_RESP]
+        assert len(resp) == 1 and resp[0].reject and resp[0].term == 9
+        p = mk_raft(1)
+        p.term = 4
+        p.handle(Message(type=MT.ELECTION, from_=1))
+        assert p.is_pre_candidate()
+        resp[0].to = 1
+        p.handle(resp[0])
+        assert p.is_follower() and p.term == 9
+
+    def test_checkquorum_lease_refuses_poll(self):
+        v = mk_raft(2, check_quorum=True)
+        v.set_leader_id(3)
+        v.election_tick = 0  # lease fresh
+        v.msgs.clear()
+        v.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=2, term=1,
+                    log_index=100, log_term=100)
+        )
+        assert v.msgs == []  # silently dropped, like the vote
+
+    def test_precandidate_becomes_follower_on_replicate(self):
+        r = mk_raft(1)
+        r.handle(Message(type=MT.ELECTION, from_=1))
+        assert r.is_pre_candidate()
+        r.handle(
+            Message(type=MT.REPLICATE, from_=2, to=1, term=0,
+                    log_index=0, log_term=0, commit=0)
+        )
+        assert r.is_follower() and r.leader_id == 2
+
+    def test_quorum_of_grants_runs_real_campaign(self):
+        r = mk_raft(1)
+        r.handle(Message(type=MT.ELECTION, from_=1))
+        r.msgs.clear()
+        r.handle(
+            Message(type=MT.REQUEST_PREVOTE_RESP, from_=2, to=1, term=1)
+        )
+        assert r.is_candidate() and r.term == 1 and r.vote == 1
+        votes = [m for m in r.msgs if m.type == MT.REQUEST_VOTE]
+        assert len(votes) == 2
+
+    def test_quorum_of_rejects_falls_back_to_follower(self):
+        r = mk_raft(1)
+        r.handle(Message(type=MT.ELECTION, from_=1))
+        for peer in (2, 3):
+            r.handle(
+                Message(
+                    type=MT.REQUEST_PREVOTE_RESP, from_=peer, to=1,
+                    term=r.term, reject=True,
+                )
+            )
+        assert r.is_follower() and r.term == 0
+
+    def test_transfer_target_skips_poll(self):
+        r = mk_raft(1)
+        r.handle(Message(type=MT.TIMEOUT_NOW, from_=2, to=1))
+        # straight to a real (term-bumping) campaign: the transfer IS the
+        # quorum's sanction
+        assert r.is_candidate() and r.term == 1
+
+    def test_witness_grants_polls_observer_ignores(self):
+        w = Raft(
+            Config(node_id=3, cluster_id=1, election_rtt=ELECTION,
+                   heartbeat_rtt=HEARTBEAT, is_witness=True),
+            InMemLogDB(),
+        )
+        w.remotes[1] = Remote(next=1)
+        w.witnesses[3] = Remote(next=1)
+        w.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=3, term=1,
+                    log_index=10, log_term=10)
+        )
+        assert any(
+            m.type == MT.REQUEST_PREVOTE_RESP and not m.reject
+            for m in w.msgs
+        )
+        o = Raft(
+            Config(node_id=4, cluster_id=1, election_rtt=ELECTION,
+                   heartbeat_rtt=HEARTBEAT, is_observer=True),
+            InMemLogDB(),
+        )
+        o.observers[4] = Remote(next=1)
+        o.handle(
+            Message(type=MT.REQUEST_PREVOTE, from_=1, to=4, term=1,
+                    log_index=10, log_term=10)
+        )
+        assert o.msgs == []
+
+
+# --------------------------------------------------------------------------
+# kernel differential with prevote ON (mirrors test_differential's round
+# structure; the scalar oracle runs the same config)
+# --------------------------------------------------------------------------
+
+
+class ScalarPrevoteCluster:
+    def __init__(self, seed_of_group, g: int = 0):
+        self.rafts = {}
+        for nid in range(1, N + 1):
+            r = Raft(
+                Config(
+                    node_id=nid, cluster_id=1, election_rtt=ELECTION,
+                    heartbeat_rtt=HEARTBEAT, pre_vote=True,
+                ),
+                InMemLogDB(),
+            )
+            for p in range(1, N + 1):
+                r.remotes[p] = Remote(next=1)
+            slot = nid - 1
+
+            def patched(r=r, slot=slot):
+                r.randomized_election_timeout = r.election_timeout + _mix(
+                    seed_of_group, r.term, slot
+                ) % r.election_timeout
+
+            r.set_randomized_election_timeout = patched
+            patched()
+            self.rafts[nid] = r
+        self.dropped_links = set()
+        self.isolated = set()
+
+    def tick_all(self):
+        for r in self.rafts.values():
+            r.tick()
+
+    def _deliverable(self, m) -> bool:
+        f, t = m.from_ - 1, m.to - 1
+        if (f, t) in self.dropped_links:
+            return False
+        return f not in self.isolated and t not in self.isolated
+
+    def settle(self, rounds=20):
+        for _ in range(rounds):
+            msgs = []
+            for r in self.rafts.values():
+                msgs.extend(m for m in r.msgs if not is_local_message(m.type))
+                r.msgs = []
+            if not msgs:
+                return
+            for m in msgs:
+                if m.to in self.rafts and self._deliverable(m):
+                    self.rafts[m.to].handle(m)
+
+    def propose(self, nid, n=1):
+        self.rafts[nid].handle(
+            Message(
+                type=MT.PROPOSE, from_=nid,
+                entries=[Entry(cmd=b"p%d" % i) for i in range(n)],
+            )
+        )
+
+    def observables(self):
+        res = []
+        for nid in range(1, N + 1):
+            r = self.rafts[nid]
+            res.append(
+                {
+                    "role": int(r.state),
+                    "term": r.term,
+                    "leader": r.leader_id - 1 if r.leader_id else -1,
+                    "committed": r.log.committed,
+                    "last": r.log.last_index(),
+                }
+            )
+        return res
+
+
+def _kernel_observables(kc, g=0):
+    res = []
+    for h in range(kc.n_replicas):
+        st = kc.states[h]
+        res.append(
+            {
+                "role": int(np.asarray(st.role)[g]),
+                "term": int(np.asarray(st.term)[g]),
+                "leader": int(np.asarray(st.leader)[g]) - 1,
+                "committed": int(np.asarray(st.committed)[g]),
+                "last": int(np.asarray(st.last_index)[g]),
+            }
+        )
+    return res
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_differential_prevote_randomized_faults(seed):
+    """Kernel (prevote on) vs scalar oracle (pre_vote=True) under a
+    seeded schedule of link faults, isolation windows and proposals:
+    role/term/leader/commit/last must agree replica-for-replica after
+    every settled round."""
+    import random
+
+    rng = random.Random(seed)
+    kc = LoopbackCluster(
+        n_replicas=N, n_groups=1, election=ELECTION, heartbeat=HEARTBEAT,
+        prevote=True, seed=0,
+    )
+    seed_of_group = int(np.asarray(kc.states[0].seed)[0])
+    sc = ScalarPrevoteCluster(seed_of_group)
+
+    def run_round(proposals=0):
+        kc.step(tick=True)
+        kc.settle()
+        sc.tick_all()
+        sc.settle()
+        if proposals:
+            lead = kc.leader_of(0)
+            if lead is not None:
+                kc.propose(lead, 0, proposals)
+                sc.propose(lead + 1, proposals)
+                kc.settle()
+                sc.settle()
+
+    for step in range(120):
+        # seeded fault churn, mirrored onto both implementations
+        if rng.random() < 0.08:
+            a, b = rng.sample(range(N), 2)
+            kc.dropped_links.add((a, b))
+            sc.dropped_links.add((a, b))
+        if rng.random() < 0.08:
+            kc.dropped_links.clear()
+            sc.dropped_links.clear()
+        if rng.random() < 0.04 and not kc.isolated:
+            v = rng.randrange(N)
+            kc.isolated.add(v)
+            sc.isolated.add(v)
+        if rng.random() < 0.10:
+            kc.isolated.clear()
+            sc.isolated.clear()
+        run_round(proposals=1 if rng.random() < 0.3 else 0)
+        ko = _kernel_observables(kc)
+        so = sc.observables()
+        assert ko == so, f"seed {seed} diverged at step {step}:\n{ko}\n{so}"
+
+
+def test_rejoin_storm_prevote_on_vs_off():
+    """The acceptance verdict at kernel level: the same isolation/heal
+    schedule disturbs the stable quorum with pre-vote OFF (term
+    inflation forces a term bump on heal) and leaves it untouched with
+    pre-vote ON."""
+
+    def run(prevote):
+        kc = LoopbackCluster(
+            n_replicas=N, n_groups=1, election=ELECTION,
+            heartbeat=HEARTBEAT, prevote=prevote,
+        )
+        for _ in range(200):
+            kc.step()
+            kc.settle()
+            if kc.leader_of(0) is not None:
+                break
+        lead = kc.leader_of(0)
+        assert lead is not None
+        base_terms = kc.field("term", 0)
+        victim = (lead + 1) % N
+        kc.isolated.add(victim)
+        for _ in range(8 * ELECTION):
+            kc.step()
+            kc.settle()
+        kc.isolated.clear()
+        for _ in range(4 * ELECTION):
+            kc.step()
+            kc.settle()
+        return lead, base_terms, kc.field("term", 0), kc.leader_of(0)
+
+    lead_on, t0_on, t1_on, lead_after_on = run(True)
+    # pre-vote ON: zero disturbance — same leader, stable quorum's term
+    # never moved, the rejoiner's term never inflated
+    assert lead_after_on == lead_on
+    assert t1_on == t0_on, f"terms moved with prevote on: {t0_on} -> {t1_on}"
+
+    lead_off, t0_off, t1_off, _ = run(False)
+    # pre-vote OFF, same schedule: the isolated replica's term inflates
+    # and the heal disturbs the quorum (term bump at minimum)
+    assert t1_off != t0_off, "expected a disturbance with prevote off"

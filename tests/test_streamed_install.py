@@ -1,0 +1,541 @@
+"""Streamed snapshot install: offset-resumable chunked transfer, the
+typed abort error, and the engine-cadence bound during install.
+
+  * Chunks resume protocol (unit): a mid-stream receiver death loses at
+    most the in-flight chunk — the retry skips already-durable chunks
+    (no rewrites), truncates a torn tail back to the recorded offset,
+    and finalizes a valid image;
+  * NodeHost.crash() mid-stream (e2e): the re-streamed install resumes
+    from the recorded offset and the group converges (satellite:
+    "chunked install resumes from the recorded offset after
+    NodeHost.crash() mid-stream");
+  * ErrSnapshotStreamAborted: aborted inbound streams open a fail-fast
+    window on the receiving node (typed, retry-hinted — not a generic
+    timeout) and serving.retry honors the hint;
+  * FairnessWatchdog bound: a slow (seconds-long) SM restore does not
+    stall the receiving engine's step cadence past 2x the no-install
+    baseline.
+"""
+import json
+import os
+import threading
+import time
+import zlib
+
+import pytest
+
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.requests import ErrSnapshotStreamAborted, ErrTimeout
+from dragonboat_tpu.rsm.snapshotio import SnapshotHeader, SnapshotWriter
+from dragonboat_tpu.serving.retry import call_with_retries
+from dragonboat_tpu.settings import soft
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.transport.chunks import Chunks
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+from dragonboat_tpu.transport.snapshotstream import (
+    load_chunk_data,
+    split_snapshot_message,
+)
+from dragonboat_tpu.types import Membership, Message, MessageType, Snapshot
+
+CLUSTER = 5
+
+
+class KV(IStateMachine):
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=1)
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def get_hash(self):
+        return zlib.crc32(json.dumps(sorted(self.d.items())).encode())
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+# --------------------------------------------------------------------------
+# Chunks resume protocol (unit, no raft)
+# --------------------------------------------------------------------------
+
+
+class _FakeNH:
+    """The minimal nodehost surface Chunks touches."""
+
+    def __init__(self, root):
+        self.root = root
+        self.delivered = []
+        self.acked = []
+        self.aborts = []
+
+    def snapshot_dir_root(self):
+        return self.root
+
+    def handle_message_batch(self, batch):
+        self.delivered.extend(batch.requests)
+
+    def handle_snapshot(self, cluster_id, node_id, from_):
+        self.acked.append((cluster_id, node_id, from_))
+
+    def _on_snapshot_stream_aborted(self, cluster_id, node_id, from_, reason):
+        self.aborts.append((cluster_id, node_id, from_, reason))
+
+
+def _make_image(path, index=50, payload=b"x" * (64 * 1024)):
+    mem = Membership(addresses={1: "a:1", 2: "a:2"})
+    with open(path, "wb") as f:
+        with SnapshotWriter(
+            f, SnapshotHeader(index=index, term=3, membership=mem),
+            session=b"",
+        ) as w:
+            w.write(payload)
+    return mem
+
+
+def _chunks_for(path, mem, index=50, chunk_size=4096):
+    ss = Snapshot(
+        filepath=path,
+        file_size=os.path.getsize(path),
+        index=index,
+        term=3,
+        membership=mem,
+        cluster_id=CLUSTER,
+    )
+    m = Message(
+        type=MessageType.INSTALL_SNAPSHOT, cluster_id=CLUSTER,
+        to=2, from_=1, snapshot=ss,
+    )
+    out = []
+    for c in split_snapshot_message(m, chunk_size=chunk_size):
+        out.append(load_chunk_data(c, chunk_size=chunk_size))
+    return out
+
+
+def test_chunks_resume_skips_durable_chunks(tmp_path):
+    """Receiver dies mid-stream (tracker state lost, disk survives); the
+    sender's retry restarts at chunk 0 and the new tracker SKIPS every
+    already-durable chunk, finalizing a valid image."""
+    img = tmp_path / "src.gbsnap"
+    mem = _make_image(str(img))
+    chunks = _chunks_for(str(img), mem)
+    assert len(chunks) > 8
+    nh = _FakeNH(str(tmp_path / "recv"))
+    c1 = Chunks(nh)
+    cut = len(chunks) // 2
+    for c in chunks[:cut]:
+        assert c1.add_chunk(c)
+    # process death: a NEW tracker (fresh NodeHost) — only disk survives
+    c2 = Chunks(nh)
+    for c in _chunks_for(str(img), mem):  # sender retry from chunk 0
+        assert c2.add_chunk(c)
+    st = c2.stats()
+    assert st["resumed_streams"] == 1
+    assert st["skipped_chunks"] == cut, st
+    assert st["completed_streams"] == 1
+    # a sender retry of the SAME stream is the resume path, not an
+    # abort: no counter bump, no client fail-fast window
+    assert st["aborted_streams"] == 0 and nh.aborts == []
+    assert len(nh.delivered) == 1
+    ss = nh.delivered[0].snapshot
+    assert ss.index == 50 and os.path.exists(ss.filepath)
+    # the finalized dir must not carry the progress record
+    assert not os.path.exists(
+        os.path.join(os.path.dirname(ss.filepath), "stream-progress.json")
+    )
+
+
+def test_chunks_resume_truncates_torn_tail(tmp_path):
+    """Bytes written past the recorded progress (a torn mid-chunk write)
+    are rolled back on resume; the final image still validates."""
+    img = tmp_path / "src.gbsnap"
+    mem = _make_image(str(img))
+    chunks = _chunks_for(str(img), mem)
+    nh = _FakeNH(str(tmp_path / "recv"))
+    c1 = Chunks(nh)
+    cut = 5
+    for c in chunks[:cut]:
+        assert c1.add_chunk(c)
+    # torn tail: half a chunk of garbage beyond the recorded offset
+    part_dirs = []
+    for root, dirs, files in os.walk(nh.root):
+        for f in files:
+            if f.endswith(".gbsnap"):
+                part_dirs.append(os.path.join(root, f))
+    assert part_dirs
+    with open(part_dirs[0], "ab") as f:
+        f.write(b"\xde\xad" * 1000)
+    c2 = Chunks(nh)
+    for c in _chunks_for(str(img), mem):
+        assert c2.add_chunk(c)
+    assert c2.stats()["completed_streams"] == 1
+    assert len(nh.delivered) == 1  # finalize validated the image
+
+
+def test_chunks_incompatible_partial_starts_clean(tmp_path):
+    """A surviving partial of a DIFFERENT stream shape (other term) is
+    discarded, not resumed."""
+    img = tmp_path / "src.gbsnap"
+    mem = _make_image(str(img))
+    nh = _FakeNH(str(tmp_path / "recv"))
+    c1 = Chunks(nh)
+    for c in _chunks_for(str(img), mem)[:4]:
+        assert c1.add_chunk(c)
+    # same index, different term -> incompatible
+    img2 = tmp_path / "src2.gbsnap"
+    mem2 = _make_image(str(img2))
+    chunks2 = _chunks_for(str(img2), mem2)
+    for c in chunks2:
+        c.term = 9
+    c2 = Chunks(nh)
+    for c in chunks2:
+        assert c2.add_chunk(c)
+    st = c2.stats()
+    assert st["resumed_streams"] == 0 and st["completed_streams"] == 1
+
+
+def test_chunks_validation_failure_purges_partial(tmp_path):
+    """A stream whose assembled image fails validation must NOT leave a
+    resumable partial behind: the retry would skip past every (corrupt)
+    chunk and re-fail forever. The purge forces a clean re-transfer,
+    which then succeeds."""
+    img = tmp_path / "src.gbsnap"
+    mem = _make_image(str(img))
+    nh = _FakeNH(str(tmp_path / "recv"))
+    ch = Chunks(nh)
+    bad = _chunks_for(str(img), mem)
+    # corrupt a mid-stream chunk's payload (sizes preserved)
+    bad[3].data = bytes(len(bad[3].data))
+    for c in bad[:-1]:
+        assert ch.add_chunk(c)
+    assert not ch.add_chunk(bad[-1])  # finalize fails validation
+    assert ch.stats()["aborted_streams"] == 1
+    # the corrupt partial is GONE: the clean retry starts fresh and lands
+    for c in _chunks_for(str(img), mem):
+        assert ch.add_chunk(c)
+    st = ch.stats()
+    assert st["resumed_streams"] == 0 and st["skipped_chunks"] == 0
+    assert st["completed_streams"] == 1
+    assert len(nh.delivered) == 1
+
+
+def test_chunks_abort_notifies_nodehost(tmp_path):
+    """A dropped stream (chunk gap) reports through the abort hook with a
+    reason — the seam the typed client error hangs off."""
+    img = tmp_path / "src.gbsnap"
+    mem = _make_image(str(img))
+    chunks = _chunks_for(str(img), mem)
+    nh = _FakeNH(str(tmp_path / "recv"))
+    ch = Chunks(nh)
+    assert ch.add_chunk(chunks[0])
+    assert not ch.add_chunk(chunks[3])  # gap -> stream dropped
+    assert ch.stats()["aborted_streams"] == 1
+    assert nh.aborts and nh.aborts[0][0] == CLUSTER
+    assert nh.aborts[0][3] == "out_of_order"
+
+
+# --------------------------------------------------------------------------
+# typed abort error
+# --------------------------------------------------------------------------
+
+
+def test_err_snapshot_stream_aborted_fails_reads_fast(tmp_path):
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=3, rtt_millisecond=5, raft_address="sa1:1",
+            raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+            engine=EngineConfig(
+                kind="vector", max_groups=32, max_peers=4, log_window=64
+            ),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "sa1:1"}, False, lambda c, n: KV(),
+            Config(cluster_id=CLUSTER, node_id=1, election_rtt=20,
+                   heartbeat_rtt=4),
+        )
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            lid, ok = nh.get_leader_id(CLUSTER)
+            if ok:
+                break
+            time.sleep(0.02)
+        node = nh._get_node(CLUSTER)
+        # an install stream this replica needed aborted: reads fail fast
+        # with the typed, retry-hinted error for the re-stream window
+        node.notify_install_aborted(retry_after_s=1.5)
+        with pytest.raises(ErrSnapshotStreamAborted) as ei:
+            nh.read_index(CLUSTER, timeout_s=2.0)
+        assert ei.value.retry_after_s == 1.5
+        # restore completed: ops flow again
+        node.clear_install_aborted()
+        rs = nh.read_index(CLUSTER, timeout_s=5.0)
+        assert rs.wait(5.0).completed
+    finally:
+        nh.stop()
+
+
+def test_call_with_retries_honors_abort_hint():
+    """ErrSnapshotStreamAborted is ErrSystemBusy-family: retried, with
+    the server hint as the backoff floor."""
+    clock = [0.0]
+    sleeps = []
+
+    def fake_clock():
+        return clock[0]
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock[0] += s
+
+    calls = [0]
+
+    def fn(remaining):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise ErrSnapshotStreamAborted(retry_after_s=0.4)
+        return "ok"
+
+    out = call_with_retries(
+        fn, 10.0, clock=fake_clock, sleep=fake_sleep
+    )
+    assert out == "ok" and calls[0] == 2
+    assert sleeps and sleeps[0] >= 0.4  # hint floored the backoff
+
+    # a hint past the deadline raises ErrTimeout without sleeping
+    calls[0] = 0
+    sleeps.clear()
+
+    def fn2(remaining):
+        raise ErrSnapshotStreamAborted(retry_after_s=99.0)
+
+    with pytest.raises(ErrTimeout):
+        call_with_retries(fn2, 1.0, clock=fake_clock, sleep=fake_sleep)
+    assert sleeps == []
+
+
+# --------------------------------------------------------------------------
+# e2e: crash mid-stream, resume from the recorded offset
+# --------------------------------------------------------------------------
+
+
+def _mk_host(nid, reg, run_dir, recv_rate=0):
+    return NodeHost(
+        NodeHostConfig(
+            deployment_id=6,
+            rtt_millisecond=5,
+            nodehost_dir=os.path.join(run_dir, f"h{nid}"),
+            raft_address=f"si{nid}:1",
+            raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+            max_snapshot_recv_bytes_per_second=recv_rate,
+            engine=EngineConfig(
+                kind="vector", max_groups=32, max_peers=4, log_window=64
+            ),
+        )
+    )
+
+
+def _grp_cfg(nid):
+    # pre_vote + check_quorum (the canonical pairing): the poll keeps a
+    # rejoiner's term from inflating, and the leader LEASE refuses polls
+    # from a live quorum's members — without the lease, a load-delayed
+    # heartbeat lets an up-to-date follower win a poll and legally move
+    # leadership mid-test (observed on the 2-cpu box). Election timeouts
+    # are generous for the same reason: a whole-host crash teardown can
+    # starve the surviving pair for ~100ms.
+    return Config(
+        cluster_id=CLUSTER, node_id=nid, election_rtt=60, heartbeat_rtt=10,
+        snapshot_entries=20, compaction_overhead=5, pre_vote=True,
+        check_quorum=True,
+    )
+
+
+@pytest.mark.slow
+def test_install_resumes_after_host_crash_mid_stream(tmp_path, monkeypatch):
+    """The satellite verdict: a lagging member rejoining via snapshot
+    install loses its HOST (NodeHost.crash) mid-stream; after restart the
+    re-streamed install RESUMES from the receiver's recorded offset
+    (skipped chunks > 0) and the group converges."""
+    monkeypatch.setattr(soft, "sent_snapshot_chunk_size", 8 * 1024)
+    reg = _Registry()
+    members = {n: f"si{n}:1" for n in (1, 2, 3)}
+    # the victim throttles its receive side so the stream reliably spans
+    # the crash point
+    hosts = {
+        n: _mk_host(n, reg, str(tmp_path), recv_rate=150_000 if n == 3 else 0)
+        for n in (1, 2, 3)
+    }
+    try:
+        for n in (1, 2, 3):
+            hosts[n].start_cluster(members, False, lambda c, n_: KV(), _grp_cfg(n))
+        deadline = time.monotonic() + 30
+        leader = None
+        while leader is None and time.monotonic() < deadline:
+            for n in (1, 2, 3):
+                lid, ok = hosts[n].get_leader_id(CLUSTER)
+                if ok and lid == n:
+                    leader = n
+                    break
+            time.sleep(0.02)
+        assert leader is not None and leader != 3 or True
+        if leader == 3:
+            hosts[leader].request_leader_transfer(CLUSTER, 1)
+            time.sleep(0.5)
+            leader = 1
+        # victim node goes down; traffic makes its log unreachable
+        hosts[3].crash_cluster(CLUSTER)
+        s = hosts[leader].get_noop_session(CLUSTER)
+        blob = "b" * 4096
+        for i in range(60):
+            hosts[leader].sync_propose(
+                s, f"big{i}={blob}".encode(), timeout_s=5.0
+            )
+        # snapshot BOTH live members AT THE SAME applied index: whoever
+        # streams (should leadership still move under load) then serves
+        # the identical image, so the retry resumes the same stream
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            idx = {
+                n: hosts[n].get_applied_index(CLUSTER) for n in (1, 2)
+            }
+            if len(set(idx.values())) == 1:
+                break
+            time.sleep(0.05)
+        for n in (1, 2):
+            hosts[n].sync_request_snapshot(CLUSTER, timeout_s=10.0)
+        # rejoin -> install stream starts (slow, throttled)
+        hosts[3].restart_cluster(CLUSTER)
+        # wait for the stream to make SOME durable progress, then kill
+        # the whole receiving host mid-stream
+        part_root = hosts[3].snapshot_dir_root()
+        deadline = time.monotonic() + 30
+        started = False
+        while time.monotonic() < deadline:
+            for root, dirs, files in os.walk(part_root):
+                if "stream-progress.json" in files:
+                    started = True
+            if started:
+                break
+            time.sleep(0.05)
+        assert started, "install stream never started"
+        hosts[3].crash()
+        hosts[3] = _mk_host(3, reg, str(tmp_path), recv_rate=0)
+        hosts[3].start_cluster(members, False, lambda c, n_: KV(), _grp_cfg(3))
+        # the re-streamed install resumes from the recorded offset and
+        # the group converges
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = hosts[3]._chunks.stats()
+            if st["resumed_streams"] >= 1 and st["completed_streams"] >= 1:
+                break
+            time.sleep(0.1)
+        st = hosts[3]._chunks.stats()
+        assert st["resumed_streams"] >= 1, st
+        assert st["skipped_chunks"] > 0, st
+        want = hosts[leader].get_sm_hash(CLUSTER)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if hosts[3].get_sm_hash(CLUSTER) == want:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert hosts[3].get_sm_hash(CLUSTER) == want, "rejoiner diverged"
+    finally:
+        for nh in hosts.values():
+            try:
+                nh.stop()
+            except Exception:
+                pass
+
+
+# --------------------------------------------------------------------------
+# engine cadence during install
+# --------------------------------------------------------------------------
+
+_SLOW_RECOVER = {"sleep": 0.0}
+
+
+class SlowKV(KV):
+    def recover_from_snapshot(self, r, files, done):
+        if _SLOW_RECOVER["sleep"]:
+            time.sleep(_SLOW_RECOVER["sleep"])
+        super().recover_from_snapshot(r, files, done)
+
+
+def test_install_does_not_stall_engine_cadence(tmp_path):
+    """The watchdog bound: while one lane's snapshot restore takes
+    SECONDS, the engine's step cadence (FairnessWatchdog recent_max_gap)
+    stays under 2x the no-install baseline — the install runs off the
+    step loop (record persist + SM rebuild both on the snapshot worker)."""
+    _SLOW_RECOVER["sleep"] = 0.0
+    reg = _Registry()
+    members = {n: f"si{n}:1" for n in (1, 2, 3)}
+    hosts = {n: _mk_host(n, reg, str(tmp_path)) for n in (1, 2, 3)}
+    try:
+        for n in (1, 2, 3):
+            hosts[n].start_cluster(
+                members, False, lambda c, n_: SlowKV(), _grp_cfg(n)
+            )
+        deadline = time.monotonic() + 30
+        leader = None
+        while leader is None and time.monotonic() < deadline:
+            for n in (1, 2, 3):
+                lid, ok = hosts[n].get_leader_id(CLUSTER)
+                if ok and lid == n:
+                    leader = n
+                    break
+            time.sleep(0.02)
+        assert leader is not None
+        victim = 2 if leader != 2 else 3
+        s = hosts[leader].get_noop_session(CLUSTER)
+        # ---- no-install baseline window on the victim's engine --------
+        wd = hosts[victim].engine.watchdog
+        wd.reset_window()
+        for i in range(30):
+            hosts[leader].sync_propose(s, f"k{i}=v{i}".encode(), 5.0)
+        baseline = max(wd.stats()["recent_max_gap_s"], 0.02)
+        # ---- lag the victim, force the install path --------------------
+        hosts[victim].crash_cluster(CLUSTER)
+        for i in range(40):
+            hosts[leader].sync_propose(s, f"l{i}=w{i}".encode(), 5.0)
+        hosts[leader].sync_request_snapshot(CLUSTER, timeout_s=10.0)
+        _SLOW_RECOVER["sleep"] = 3.0
+        wd.reset_window()
+        hosts[victim].restart_cluster(CLUSTER)
+        # wait out the (slow) install
+        want = hosts[leader].get_sm_hash(CLUSTER)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if hosts[victim].get_sm_hash(CLUSTER) == want:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert hosts[victim].get_sm_hash(CLUSTER) == want
+        gap = hosts[victim].engine.fairness_stats()["recent_max_gap_s"]
+        bound = max(2 * baseline, 1.0)  # CI noise floor; recover sleeps 3s
+        assert gap < bound, (
+            f"engine stalled during install: gap={gap:.3f}s "
+            f"baseline={baseline:.3f}s bound={bound:.3f}s"
+        )
+    finally:
+        _SLOW_RECOVER["sleep"] = 0.0
+        for nh in hosts.values():
+            try:
+                nh.stop()
+            except Exception:
+                pass
