@@ -37,6 +37,8 @@ STATE = "ops/state.py"
 SERVING_ADMISSION = "serving/admission.py"
 SERVING_BACKPRESSURE = "serving/backpressure.py"
 SERVING_FRONT = "serving/front.py"
+SERVING_SESSIONS = "serving/sessions.py"
+SERVING_PLACEMENT = "serving/placement.py"
 CHUNKS = "transport/chunks.py"
 
 FnKey = Tuple[str, str]  # (relpath, qualname)
@@ -222,6 +224,20 @@ def _default_targets() -> Targets:
             "take NodeHost._nodes_mu inside it",
         ),
         LockSpec(
+            "PlacementPlane", "_mu", 35,
+            "placement plan/active-migration table + migration ledger; "
+            "outer of NodeHost._nodes_mu (the load fold and every "
+            "migration step call into the host's request API, which "
+            "takes _nodes_mu inside)",
+        ),
+        LockSpec(
+            "SessionManager", "_mu", 37,
+            "session pool + lifecycle counters; outer of "
+            "NodeHost._nodes_mu for the same reason (checkout never "
+            "holds it across a propose, but the rank keeps any future "
+            "nesting legal in one direction only)",
+        ),
+        LockSpec(
             "NodeHost", "_nodes_mu", 38,
             "node registry + launch-spec table (the restart plane: "
             "stop/crash/restart_cluster all transition through it); held "
@@ -358,6 +374,9 @@ def _default_targets() -> Targets:
             "NodeHost": {
                 "_nodes": "_nodes_mu",
                 "_launch_specs": "_nodes_mu",
+                # live-migration tag set (serving/placement.py): read by
+                # the inbound chunk tracker on every stream begin
+                "_migrating": "_nodes_mu",
             },
         },
         # the serving overload plane (ISSUE 8): admit/shed decisions and
@@ -387,6 +406,25 @@ def _default_targets() -> Targets:
         SERVING_FRONT: {
             "ServingFront": {"_queues": "_mu"},
         },
+        # the millions-of-users plane (ISSUE 14): the session pools and
+        # the migration ledger are mutated from client threads, the
+        # placement pacer and teardown — a write outside the declared
+        # lock is a lost-session / double-migration class of bug
+        SERVING_SESSIONS: {
+            "SessionManager": {
+                "_pools": "_mu",
+                "_counters": "_mu",
+                "_dead": "_mu",
+            },
+        },
+        SERVING_PLACEMENT: {
+            "PlacementPlane": {
+                "_active": "_mu",
+                "_counters": "_mu",
+                "_last_lanes": "_mu",
+                "_abort": "_mu",
+            },
+        },
         # the streamed-install plane (ISSUE 13): the stream tracker and
         # its resume/abort counters are mutated from transport delivery
         # threads and the tick sweeper — a write outside _mu is exactly
@@ -399,6 +437,7 @@ def _default_targets() -> Targets:
                 "_skipped_chunks": "_mu",
                 "_aborted_streams": "_mu",
                 "_completed_streams": "_mu",
+                "_migration_streams": "_mu",
             },
         },
     }
@@ -451,6 +490,8 @@ __all__ = [
     "SERVING_ADMISSION",
     "SERVING_BACKPRESSURE",
     "SERVING_FRONT",
+    "SERVING_PLACEMENT",
+    "SERVING_SESSIONS",
     "STATE",
     "TRACE",
     "TRANSPORT",

@@ -389,6 +389,9 @@ class ExecEngine:
                 "leader_id": st["leader_id"],
                 "term": st["term"],
                 "commit_gap": max(int(last - st["commit"]), 0),
+                # append high-water mark (vector-parity key: the
+                # placement plane's ingest-rate delta signal)
+                "last_index": int(last),
                 "ticks_since_leader_change": max(
                     int(tick - getattr(node, "_leader_change_tick", 0)), 0
                 ),

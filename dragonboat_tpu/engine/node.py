@@ -80,6 +80,7 @@ class Node:
         event_listener=None,
         rng: Optional[random.Random] = None,
         send_messages: Optional[Callable[[List[Message]], None]] = None,
+        register_peer: Optional[Callable[[int, int, str], None]] = None,
     ) -> None:
         self.config = cfg
         self.cluster_id = cfg.cluster_id
@@ -91,6 +92,14 @@ class Node:
         # optional bulk path (one co-hosted delivery pass + one grouped
         # wire send per batch); None falls back to per-message sends
         self._send_messages = send_messages
+        # host transport registrar: a committed ADD_* config change (and
+        # a snapshot-restored membership) carries the member's address in
+        # REPLICATED state, so every applying replica can register it —
+        # without this, only the host that REQUESTED the change can route
+        # to the new member, and a migrated-in replica strands the moment
+        # leadership leaves that host (cf. nodes.go: the reference gets
+        # the same cluster-wide knowledge from its nodehost registry)
+        self._register_peer = register_peer
         self.engine = engine
         self.events = event_listener
         self.clock = self._make_clock(engine)
@@ -303,10 +312,35 @@ class Node:
     def apply_config_change(self, cc: ConfigChange) -> None:
         """Called by the RSM when a config change commits; updates the
         protocol-core membership (cf. node.go applyConfigChange)."""
+        self._register_cc_address(cc)
         with self._mu:
             self.peer.apply_config_change(cc)
         if cc.node_id == self._node_id and cc.type.name == "REMOVE_NODE":
             pass  # node removal handled by nodehost monitor
+
+    def _register_cc_address(self, cc: ConfigChange) -> None:
+        """Every replica applying an ADD_* change registers the new
+        member's address with its host transport: the address rides the
+        replicated entry, so routing knowledge is cluster-wide, not
+        request-host-local (a live migration's swapped-in member must
+        stay reachable after leadership leaves the host that added it)."""
+        if self._register_peer is not None and cc.address:
+            self._register_peer(self.cluster_id, cc.node_id, cc.address)
+
+    def membership_loaded(self, membership) -> None:
+        """A snapshot restore installed a full membership image: register
+        every member's address (the joiner's ONLY source of its peers'
+        addresses — its bootstrap is empty by definition of join)."""
+        if self._register_peer is None:
+            return
+        for table in (
+            membership.addresses,
+            getattr(membership, "observers", None) or {},
+            getattr(membership, "witnesses", None) or {},
+        ):
+            for nid, addr in table.items():
+                if addr:
+                    self._register_peer(self.cluster_id, nid, addr)
 
     def config_change_processed(self, key: int, accepted: bool) -> None:
         if accepted:

@@ -356,7 +356,10 @@ class VectorNode(Node):
     # ------------------------------------------------- INodeProxy overrides
     def apply_config_change(self, cc) -> None:
         """A config change committed and passed the membership legality
-        checks: reconcile the device lane (slot remap) on the engine loop."""
+        checks: reconcile the device lane (slot remap) on the engine loop.
+        The new member's address registers host-wide first (base-class
+        seam): the replicated entry is every replica's routing source."""
+        self._register_cc_address(cc)
         self.engine.membership_changed(self)
 
     def config_change_processed(self, key: int, accepted: bool) -> None:
@@ -3919,6 +3922,11 @@ class VectorEngine:
                 "leader_id": lane.rev.get(int(leader[g]) - 1, 0),
                 "term": int(term[g]),
                 "commit_gap": max(int(last[g] - commit[g]), 0),
+                # monotonic append high-water mark in device units: the
+                # placement plane's ingest-rate signal is the DELTA of
+                # this between two load folds (serving/placement.py) —
+                # still a pure mirror read, zero device syncs
+                "last_index": int(last[g]),
                 "ticks_since_leader_change": max(int(tick - chg[g]), 0),
                 # lane-variant probes: the replica's role (observer/witness
                 # lanes included) and resident client-payload bytes — a

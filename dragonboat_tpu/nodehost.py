@@ -210,6 +210,13 @@ class NodeHost(IMessageHandler):
         # _serving_mu
         self._serving = None
         self._serving_mu = threading.Lock()
+        # lazily-created placement plane (serving/placement.py); same
+        # create/teardown discipline as the front
+        self._placement = None
+        # clusters mid live-migration (serving/placement.py): consulted
+        # by the inbound chunk tracker to tag migration install streams;
+        # guarded by _nodes_mu like the rest of the cluster tables
+        self._migrating: set = set()
         # ping/pong RTT samples: (cluster_id, peer) -> deque of microseconds
         self._rtt_mu = threading.Lock()
         self._rtt: Dict[tuple, object] = {}
@@ -286,6 +293,12 @@ class NodeHost(IMessageHandler):
         self._stopped.set()
         with self._serving_mu:
             front, self._serving = self._serving, None
+            plane, self._placement = self._placement, None
+        if plane is not None:
+            # the pacer thread must die first (graceful or not): a
+            # migration step against a closing host is just churn
+            plane.abort()
+            plane.stop()
         if front is not None and not crashed:
             # graceful stop drains queued tickets with ErrClusterClosed;
             # a crash abandons them exactly like every other in-flight
@@ -543,6 +556,7 @@ class NodeHost(IMessageHandler):
             send_messages=self._send_messages,
             engine=self.engine,
             event_listener=self._event_aggregator,
+            register_peer=self._register_peer_address,
         )
         with self._nodes_mu:
             self._nodes[cluster_id] = node
@@ -662,6 +676,17 @@ class NodeHost(IMessageHandler):
         )
         self.start_cluster(initial_members, join, sm_factory, cfg)
 
+    def _register_peer_address(
+        self, cluster_id: int, node_id: int, address: str
+    ) -> None:
+        """Replicated-state address registration (Node.apply_config_change
+        / membership_loaded): an applied ADD_* change or a restored
+        snapshot membership names a member's address — record it so THIS
+        host can route to the member no matter which host requested the
+        change (live migration depends on it: the swapped-in member must
+        stay reachable after the adding host leaves the group)."""
+        self.transport.nodes.add_node(cluster_id, node_id, address)
+
     def has_node(self, cluster_id: int) -> bool:
         with self._nodes_mu:
             return cluster_id in self._nodes
@@ -765,6 +790,47 @@ class NodeHost(IMessageHandler):
                     self, admission=admission, front=front
                 )
             return self._serving
+
+    def placement_plane(self, targets=None, config=None):
+        """This host's load-aware placement brain (serving/placement.py):
+        folds the saturation score, per-lane gauges and per-tenant
+        serving histograms into a load model and live-migrates hot
+        groups (leadership transfer + streamed-snapshot member swap) to
+        the given MigrationTargets. Created lazily, ONE per host (the
+        first call's targets/config win); torn down with the host. Its
+        migration ledger exports through write_health_metrics."""
+        # resolve the front FIRST: serving_front() takes _serving_mu too
+        # (non-reentrant), and the plane's constructor needs it
+        front = self.serving_front()
+        with self._serving_mu:
+            if self._placement is None:
+                from .serving import PlacementPlane
+
+                self._placement = PlacementPlane(
+                    self, targets or [], config=config, front=front
+                )
+            return self._placement
+
+    def mark_migrating(self, cluster_id: int, active: bool) -> None:
+        """Tag/untag a cluster as mid live-migration on this host (both
+        the source and the join target get marked): the inbound snapshot
+        chunk tracker counts streams for marked clusters as MIGRATION
+        streams, so the bench/longhaul ledgers can tell a migration's
+        install traffic from ordinary catch-up."""
+        with self._nodes_mu:
+            if active:
+                self._migrating.add(cluster_id)
+            else:
+                self._migrating.discard(cluster_id)
+
+    def is_migrating(self, cluster_id: int) -> bool:
+        with self._nodes_mu:
+            return cluster_id in self._migrating
+
+    def local_node_id(self, cluster_id: int) -> int:
+        """The node id THIS host runs for the cluster (placement needs
+        to know which member is 'here' before it can move it away)."""
+        return self._get_node(cluster_id).node_id()
 
     def ingress_fill(self) -> float:
         """Worst incoming-proposal/read queue fill across this host's
@@ -1366,6 +1432,11 @@ class NodeHost(IMessageHandler):
         front = self._serving
         if front is not None:
             front.export_gauges(self.metrics)
+        # placement plane: the migration ledger (started/completed/
+        # aborted), same cadence as the serving gauges
+        plane = self._placement
+        if plane is not None:
+            plane.export_gauges(self.metrics)
         lane_stats = getattr(self.engine, "lane_stats", None)
         if lane_stats is not None:
             for cid, s in lane_stats().items():

@@ -86,6 +86,26 @@ class ErrSnapshotStreamAborted(ErrSystemBusy):
         self.retry_after_s = float(retry_after_s)
 
 
+class ErrMigrationAborted(ErrSystemBusy):
+    """A live group migration (serving/placement.py: leadership transfer
+    + streamed-snapshot member swap) was aborted mid-flight — operator
+    abort, catch-up timeout, or an admission shed of the migration's own
+    bulk-class traffic. The group stays where it was and keeps serving;
+    the move itself is what failed, and it is safe to retry once the
+    pressure that killed it clears. Subclasses ErrSystemBusy so
+    serving.retry.call_with_retries retries it automatically, honoring
+    `retry_after_s` (sized by the aborting step: an admission shed
+    forwards the shed's own hint, a catch-up timeout suggests one
+    snapshot-status window) as the backoff floor."""
+
+    code = "group migration aborted, retry later"
+
+    def __init__(self, retry_after_s: float = 0.0, reason: str = ""):
+        super().__init__(reason or self.code)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+
+
 class ErrInvalidSession(RequestError):
     code = "invalid session"
 
@@ -768,6 +788,7 @@ __all__ = [
     "ErrCanceled",
     "ErrRejected",
     "ErrSystemBusy",
+    "ErrMigrationAborted",
     "ErrInvalidSession",
     "ErrTimeoutTooSmall",
     "ErrPayloadTooBig",

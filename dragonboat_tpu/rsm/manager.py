@@ -334,6 +334,7 @@ class StateMachineManager:
         if ss.witness or ss.dummy:
             with self._mu:
                 self._apply_snapshot_meta(ss)
+            self._notify_membership_loaded(ss)
             return ss.index
         on_disk = self._sm.on_disk()
         with self._mu:
@@ -345,12 +346,14 @@ class StateMachineManager:
             # SM's own durable state is already newer than the snapshot image
             with self._mu:
                 self._apply_snapshot_meta(ss)
+            self._notify_membership_loaded(ss)
             return ss.index
         self._snapshotter.load(ss, self._make_load_fn(ss))
         with self._mu:
             self._apply_snapshot_meta(ss)
             if on_disk:
                 self._on_disk_index = max(self._on_disk_index, ss.on_disk_index)
+        self._notify_membership_loaded(ss)
         return ss.index
 
     def _apply_snapshot_meta(self, ss: Snapshot) -> None:
@@ -358,6 +361,18 @@ class StateMachineManager:
         self._term = max(self._term, ss.term)
         if ss.membership is not None:
             self._members.set_membership(ss.membership)
+
+    def _notify_membership_loaded(self, ss: Snapshot) -> None:
+        """Outside _mu: a restored membership image names every member's
+        ADDRESS — the node runtime registers them with the host transport
+        (a join-started node's bootstrap is empty; the snapshot is its
+        only source of peer routing). Optional on the proxy: minimal
+        INodeProxy implementations (tests/tools) skip it."""
+        if ss.membership is None:
+            return
+        cb = getattr(self._node, "membership_loaded", None)
+        if cb is not None:
+            cb(ss.membership)
 
     def _make_load_fn(self, ss: Snapshot):
         def load(reader, session_bytes: bytes, files) -> None:

@@ -83,12 +83,19 @@ class Ticket:
 
 
 class _QueuedOp:
-    __slots__ = ("cluster_id", "cmd", "ticket")
+    __slots__ = ("cluster_id", "cmd", "ticket", "session")
 
-    def __init__(self, cluster_id: int, cmd: bytes, ticket: Ticket) -> None:
+    def __init__(
+        self, cluster_id: int, cmd: bytes, ticket: Ticket, session=None
+    ) -> None:
         self.cluster_id = cluster_id
         self.cmd = cmd
         self.ticket = ticket
+        # None = noop-session bulk op (batchable); a client.Session means
+        # this op carries at-most-once dedup state and must be submitted
+        # individually with ITS session (registered sessions are strictly
+        # sequential — see Node.propose_batch)
+        self.session = session
 
 
 @dataclass
@@ -171,17 +178,23 @@ class ServingFront:
 
     # ------------------------------------------------------------ bulk path
     def propose(
-        self, tenant_id: int, cluster_id: int, cmd: bytes, timeout_s: float
+        self,
+        tenant_id: int,
+        cluster_id: int,
+        cmd: bytes,
+        timeout_s: float,
+        session=None,
     ) -> Ticket:
         """Admit one bulk proposal for tenant_id and queue it for the
         weighted-fair pump. Sheds synchronously (typed ErrOverloaded)
         when the tenant's bucket is empty, the host is saturated, or the
-        tenant's queue bound is hit."""
+        tenant's queue bound is hit. An optional client.Session makes
+        the op SESSION-MANAGED (see propose_session)."""
         self.admission.admit(tenant_id, KLASS_BULK)
         self._wake_if_quiesced(tenant_id, cluster_id)
         now = time.monotonic()
         ticket = Ticket(now + timeout_s, now)
-        op = _QueuedOp(cluster_id, cmd, ticket)
+        op = _QueuedOp(cluster_id, cmd, ticket, session=session)
         with self._mu:
             # checked under the queue lock: stop() drains the queues
             # under the same lock AFTER setting _stopped, so an op either
@@ -203,6 +216,27 @@ class ServingFront:
             )
         self._work.set()
         return ticket
+
+    def propose_session(
+        self,
+        tenant_id: int,
+        cluster_id: int,
+        session,
+        cmd: bytes,
+        timeout_s: float,
+    ) -> Ticket:
+        """Admit one SESSION-MANAGED bulk proposal: same admission, same
+        weighted-fair pump and the same typed sheds as propose(), but the
+        op rides its client.Session so the RSM's (client_id, series_id,
+        responded_to) dedup applies end-to-end — a deadline-retried
+        proposal that already applied completes with the CACHED result
+        instead of double-applying. The caller owns the session's
+        sequencing: one in-flight proposal per session, and
+        proposal_completed() only after a completed result (see
+        serving/sessions.py, which manages both)."""
+        return self.propose(
+            tenant_id, cluster_id, cmd, timeout_s, session=session
+        )
 
     def sync_propose(
         self, tenant_id: int, cluster_id: int, cmd: bytes, timeout_s: float
@@ -314,6 +348,11 @@ class ServingFront:
             if op.ticket.deadline <= now:
                 op.ticket._complete(RequestResult(code=REQUEST_TIMEOUT))
                 continue
+            if op.session is not None:
+                # session-managed: one propose with the op's OWN session
+                # (dedup ids must ride the entry; batching is noop-only)
+                self._submit_session_op(tenant_id, op, now)
+                continue
             by_cluster.setdefault(op.cluster_id, []).append(op)
         for cid, group in by_cluster.items():
             timeout_s = max(
@@ -350,6 +389,30 @@ class ServingFront:
                         tid, t, r.result
                     )
                 )
+
+    def _submit_session_op(
+        self, tenant_id: int, op: _QueuedOp, now: float
+    ) -> None:
+        timeout_s = max(op.ticket.deadline - now, 0.001)
+        try:
+            rs = self._nh.propose(op.session, op.cmd, timeout_s)
+        except ErrSystemBusy as e:
+            self.admission.note_downstream_shed(tenant_id, KLASS_BULK)
+            hint = getattr(e, "retry_after_s", 0.0) or (
+                self.config.pump_interval_s * 8
+            )
+            op.ticket._fail(
+                ErrBackpressure(retry_after_s=hint, reason="engine busy")
+            )
+            return
+        except RequestError as e:
+            op.ticket._fail(e)
+            return
+        rs.on_complete(
+            lambda r, t=op.ticket, tid=tenant_id: self._finish(
+                tid, t, r.result
+            )
+        )
 
     def _finish(self, tenant_id: int, ticket: Ticket, res) -> None:
         """Completion fan-in for one submitted proposal. An engine-side

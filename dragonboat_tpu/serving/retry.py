@@ -20,7 +20,7 @@ from .admission import ErrOverloaded
 
 
 def call_with_retries(
-    fn: Callable[[float], object],
+    fn: Callable[..., object],
     deadline_s: float,
     *,
     base_s: float = 0.01,
@@ -29,6 +29,7 @@ def call_with_retries(
     rng: Optional[random.Random] = None,
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
+    session=None,
 ) -> object:
     """Run `fn(remaining_s)` until it succeeds or the deadline expires.
 
@@ -43,19 +44,37 @@ def call_with_retries(
     backoff that would cross the deadline raises ErrTimeout instead of
     sleeping — retries never outlive the caller's timeout.
 
+    When `session` (a client.Session) is given, fn is called as
+    `fn(remaining_s, session)` and every retry reuses the SAME session
+    object — the series_id MUST NOT advance between attempts, so a
+    retried proposal that already applied dedups to the RSM's cached
+    result instead of double-applying as an accidental new series. An
+    attempt that advanced the series (it completed) yet still raised a
+    retryable error is refused rather than retried: re-proposing under
+    the advanced series would be a fresh apply, exactly the double-apply
+    this parameter exists to prevent.
+
     rng/clock/sleep are injectable for deterministic tests."""
     if deadline_s <= 0:
         raise ErrTimeout()
     rng = rng if rng is not None else random.Random()
     deadline = clock() + deadline_s
     attempt = 0
+    series0 = session.series_id if session is not None else None
     while True:
         remaining = deadline - clock()
         if remaining <= 0:
             raise ErrTimeout()
         try:
+            if session is not None:
+                return fn(remaining, session)
             return fn(remaining)
         except ErrSystemBusy as e:
+            if session is not None and session.series_id != series0:
+                raise RuntimeError(
+                    "session series advanced across a retryable failure; "
+                    "retrying would double-apply under a new series"
+                ) from e
             hint = float(getattr(e, "retry_after_s", 0.0) or 0.0)
             cap = min(base_s * (factor ** attempt), max_backoff_s)
             delay = max(rng.random() * cap, hint)

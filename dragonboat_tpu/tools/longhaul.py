@@ -92,8 +92,14 @@ SCENARIOS = (
     "observer_witness_churn",
     "prevote_rejoin_storm",
     "streamed_install_under_crash",
+    "rebalance_under_load",
     "none",
 )
+
+# the rebalance scenario runs its own throw-away group so a live
+# migration (member swap) never perturbs the main cluster's 3-way
+# convergence verdicts; the churn host serves as the migration target
+MIG_CLUSTER = 9
 
 
 class _HashKV(IStateMachine):
@@ -225,7 +231,7 @@ def _member_config(nid: int, **overrides) -> Config:
     return Config(**kw)
 
 
-def _find_leader(hosts, deadline_s=10.0):
+def _find_leader(hosts, deadline_s=10.0, cluster=CLUSTER):
     deadline = time.monotonic() + deadline_s
     while time.monotonic() < deadline:
         for nid in HOSTS:
@@ -233,7 +239,7 @@ def _find_leader(hosts, deadline_s=10.0):
             if nh is None:
                 continue
             try:
-                lid, ok = nh.get_leader_id(CLUSTER)
+                lid, ok = nh.get_leader_id(cluster)
             except Exception:
                 continue
             if ok and lid == nid and not nh.is_partitioned():
@@ -317,6 +323,15 @@ class _Round:
         # stable quorum; any leader change or stable-quorum term bump
         # observed across it counts as a disturbance
         self._pv = {"storms": 0, "disturbed": 0}
+        # rebalance-under-load ledger (ISSUE 14): one live migration of
+        # a hot throw-away group per round — the recorded client history
+        # must stay linearizable ACROSS the member swap and no urgent-
+        # class op may be policy-shed while migration traffic (bulk
+        # class) is in flight
+        self._mig = {
+            "runs": 0, "completed": 0, "aborted": 0,
+            "lincheck_ok": True, "urgent_shed": 0,
+        }
 
     # ------------------------------------------------------------ lifecycle
     def run(self) -> RoundResult:
@@ -678,6 +693,189 @@ class _Round:
                 leader_before=leader, leader_after=leader_after,
             )
 
+    def _op_rebalance_under_load(self) -> None:
+        """ISSUE 14: hot-tenant skew on a throw-away group triggers a
+        LIVE MIGRATION mid-round — the serving plane's placement brain
+        moves the (score-forced) saturated leader-host replica onto the
+        churn host over leadership transfer + the streamed snapshot
+        install path, while skewed client load keeps flowing through
+        the front. Verdicts: the recorded history stays linearizable
+        across the swap (migration_lincheck) and zero urgent-class ops
+        are policy-shed while the migration's bulk-class traffic is in
+        flight (migration_no_urgent_shed). One migration per round (the
+        throw-away group's bring-up bounds the cost)."""
+        from ..serving import PlacementConfig, host_target
+        from ..serving.placement import MigrationPlan
+
+        # draws FIRST (replay determinism, see _op_transfer)
+        fp = self.fp
+        hot_tenant = fp.choice("longhaul", "mig_hot", [21, 22, 23])
+        n_ops = int(fp.uniform("longhaul", "mig_ops", 36, 72))
+        if self._mig["runs"]:
+            return  # one live migration per round
+        churn_nh = self.hosts.get(CHURN_HOST)
+        if churn_nh is None or any(
+            self.hosts.get(h) is None for h in HOSTS
+        ):
+            return  # a host is mid-crash: skip, the draws still burned
+        self._mig["runs"] += 1
+        members = {h: f"c{h}:1" for h in HOSTS}
+        for h in HOSTS:
+            self.hosts[h].start_cluster(
+                members, False, lambda c, n: _HashKV(),
+                _member_config(
+                    h, cluster_id=MIG_CLUSTER,
+                    snapshot_entries=24, compaction_overhead=6,
+                ),
+            )
+        rec = HistoryRecorder()
+        stop = threading.Event()
+        try:
+            leader = _find_leader(
+                self.hosts, deadline_s=20.0, cluster=MIG_CLUSTER
+            )
+            if leader is None:
+                self._mig["lincheck_ok"] = False
+                return
+            src_nh = self.hosts[leader]
+            front = src_nh.serving_front()
+            shed0 = self._urgent_sheds()
+
+            def load_main():
+                i = 0
+                while not stop.is_set() and i < n_ops:
+                    lid = _find_leader(
+                        self.hosts, deadline_s=3.0, cluster=MIG_CLUSTER
+                    )
+                    tgt = self.hosts.get(lid) if lid else None
+                    if tgt is None:
+                        # post-swap the leader may live on the CHURN
+                        # host (not in HOSTS): serve through it
+                        try:
+                            if churn_nh.has_node(MIG_CLUSTER):
+                                tgt = churn_nh
+                        except Exception:
+                            tgt = None
+                    if tgt is None:
+                        time.sleep(0.05)
+                        continue
+                    f = tgt.serving_front()
+                    i += 1
+                    key = f"m{i % 3}"
+                    if i % 4 == 0:
+                        op = rec.invoke(hot_tenant, ("get", key))
+                        try:
+                            v = f.sync_read(
+                                hot_tenant, MIG_CLUSTER, key, 2.0
+                            )
+                            rec.complete(op, v)
+                        except Exception:
+                            rec.fail(op)  # reads have no side effect
+                    else:
+                        val = f"w{i}"
+                        op = rec.invoke(
+                            hot_tenant, ("put", key, val)
+                        )
+                        try:
+                            f.sync_propose(
+                                hot_tenant, MIG_CLUSTER,
+                                f"{key}={val}".encode(), 2.0,
+                            )
+                            rec.complete(op, None)
+                        except Exception:
+                            rec.unknown(op)
+                    time.sleep(0.01)
+
+            loader = threading.Thread(target=load_main, daemon=True)
+            loader.start()
+            # let the log pass the snapshot threshold so the joiner's
+            # catch-up rides the streamed install path
+            deadline = time.monotonic() + 15
+            while (
+                time.monotonic() < deadline
+                and src_nh.get_applied_index(MIG_CLUSTER) < 30
+            ):
+                time.sleep(0.1)
+            try:
+                src_nh.sync_request_snapshot(MIG_CLUSTER, timeout_s=10.0)
+            except RequestError:
+                pass  # a periodic snapshot may already cover it
+            # saturation forced ABOVE the rebalance trigger and BELOW
+            # the hard bulk-shed line: migration's bulk class stays
+            # admitted, urgent is untouched either way
+            front.monitor.set_override(0.75)
+            plane = src_nh.placement_plane(
+                targets=[
+                    host_target(
+                        churn_nh, lambda c, n: _HashKV(),
+                        lambda c, n: _member_config(
+                            n, cluster_id=MIG_CLUSTER,
+                            snapshot_entries=0, compaction_overhead=0,
+                        ),
+                    )
+                ],
+                config=PlacementConfig(
+                    catchup_timeout_s=30.0, transfer_timeout_s=20.0,
+                ),
+            )
+            plan = MigrationPlan(
+                cluster_id=MIG_CLUSTER,
+                local_node_id=leader,
+                new_node_id=100 + self._mig["runs"],
+                target=plane.targets[0],
+                reason="rebalance_under_load",
+            )
+            try:
+                plane.execute(plan)
+                self._mig["completed"] += 1
+            except RequestError:
+                # a typed ErrMigrationAborted leaves the group serving
+                # where it was — the verdicts below still judge the
+                # history and the urgent ledger
+                self._mig["aborted"] += 1
+            finally:
+                front.monitor.set_override(None)
+            # stop BEFORE joining: a wedged group must not stall the
+            # round, and the history snapshot below must not race the
+            # loader's final completions
+            stop.set()
+            loader.join(timeout=30)
+            self._mig["urgent_shed"] += max(
+                self._urgent_sheds() - shed0, 0
+            )
+            ok = check_kv_history(rec.history(), max_states=2_000_000)
+            self._mig["lincheck_ok"] = self._mig["lincheck_ok"] and ok
+            flight_recorder().record(
+                "rebalance_under_load_done", cluster=MIG_CLUSTER,
+                completed=self._mig["completed"],
+                aborted=self._mig["aborted"], lincheck=ok,
+                ops=len(rec.history()),
+            )
+        finally:
+            stop.set()
+            for nh in list(self.hosts.values()) + [churn_nh]:
+                if nh is None:
+                    continue
+                try:
+                    if nh.has_node(MIG_CLUSTER):
+                        nh.stop_cluster(MIG_CLUSTER)
+                except Exception:
+                    pass
+
+    def _urgent_sheds(self) -> int:
+        """POLICY sheds of the urgent class across every live host's
+        serving front (the migration verdict's no-starvation probe)."""
+        total = 0
+        for nh in self.hosts.values():
+            if nh is None:
+                continue
+            front = getattr(nh, "_serving", None)
+            if front is None:
+                continue
+            for c in front.admission.counters().values():
+                total += c["shed"]["urgent"]
+        return total
+
     def _quorum_terms(self, hosts_ids) -> Optional[dict]:
         out = {}
         for h in hosts_ids:
@@ -868,6 +1066,13 @@ class _Round:
         # zero term bumps) — the pre-vote acceptance verdict
         if self._pv["storms"]:
             v["prevote_no_disturbance"] = self._pv["disturbed"] == 0
+        # rebalance under load (only when the scenario fired): the
+        # client history recorded ACROSS the live migration must stay
+        # linearizable, and the migration's bulk-class traffic must
+        # never have cost an urgent-class op a policy shed
+        if self._mig["runs"]:
+            v["migration_lincheck"] = self._mig["lincheck_ok"]
+            v["migration_no_urgent_shed"] = self._mig["urgent_shed"] == 0
 
     # ------------------------------------------------------------ artifacts
     def _bundle_failure(self) -> None:

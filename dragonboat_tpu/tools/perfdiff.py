@@ -151,6 +151,18 @@ def _steps_per_sync(cfg: dict) -> int:
         return 1
 
 
+def _workload(cfg: dict) -> str:
+    """The config's measured workload shape. `through_front` runs drive
+    traffic THROUGH SessionManager/ServingFront (admission, weighted-
+    fair pump, session dedup), so their headline is ADMITTED throughput
+    — a different machine than a raw propose_batch run. Records that
+    predate the stamp are raw by construction."""
+    w = cfg.get("workload")
+    if w:
+        return str(w)
+    return "through_front" if cfg.get("session_mode") else "raw"
+
+
 def _host_id(rec: dict) -> Optional[str]:
     """The record's box fingerprint (bench.py stamps hostname/cpu-count
     plus a timed calibration spin). None = legacy record, pre-stamp."""
@@ -223,6 +235,21 @@ def compare_config(
             "reasons": [
                 f"steps_per_sync mismatch: old ran K={ok}, new ran K={nk};"
                 " per-phase deltas would compare different engines"
+            ],
+        }
+    # ---- honesty: through-front vs raw is a different workload --------
+    # an admitted-throughput number (admission control + weighted-fair
+    # pump + session dedup in the path) "regressing" against a raw
+    # propose_batch number is a workload change, not a perf delta (same
+    # rule shape as the scaled-down and K refusals)
+    ow, nw = _workload(old), _workload(new)
+    if ow != nw:
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"workload mismatch: old measured '{ow}', new measured "
+                f"'{nw}'; admitted-front throughput and raw "
+                "propose_batch throughput are different machines"
             ],
         }
     out: dict = {"verdict": PASS, "reasons": reasons}

@@ -67,6 +67,12 @@ class Chunks:
         self._skipped_chunks = 0
         self._aborted_streams = 0
         self._completed_streams = 0
+        # install streams that began while their cluster was marked
+        # mid live-migration on this host (NodeHost.mark_migrating, set
+        # by serving/placement.py on both ends of a member swap): the
+        # counter that lets the bench/longhaul ledgers tell migration
+        # install traffic from ordinary crash-rejoin catch-up
+        self._migration_streams = 0
 
     def _key(self, c: SnapshotChunk) -> Tuple[int, int, int]:
         return (c.cluster_id, c.node_id, c.from_)
@@ -78,6 +84,7 @@ class Chunks:
                 "skipped_chunks": self._skipped_chunks,
                 "aborted_streams": self._aborted_streams,
                 "completed_streams": self._completed_streams,
+                "migration_streams": self._migration_streams,
             }
 
     # ------------------------------------------------------------------ entry
@@ -93,6 +100,12 @@ class Chunks:
                 t = self._begin_locked(c)
                 if t is None:
                     return False
+                # migration tagging: is_migrating takes NodeHost._nodes_mu
+                # INSIDE Chunks._mu — hierarchy-legal (rank 36 -> 38) and
+                # the probe is one set lookup
+                is_mig = getattr(self._nh, "is_migrating", None)
+                if is_mig is not None and is_mig(c.cluster_id):
+                    self._migration_streams += 1
             elif t is None or c.chunk_id != t.next_chunk:
                 if t is not None:
                     self._drop_locked(key, reason="out_of_order")

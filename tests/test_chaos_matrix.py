@@ -196,6 +196,45 @@ def test_seed_matrix_drop_partition_converges(tmp_path, seed):
 
 
 @pytest.mark.chaos
+def test_rebalance_under_load_scenario(tmp_path):
+    """ISSUE 14: the `rebalance_under_load` longhaul scenario in the
+    `-m chaos` matrix — hot-tenant skew on a throw-away group, a live
+    migration (member swap onto the churn host over transfer + the
+    streamed install path) mid-round, and the round's verdict set
+    including migration_lincheck + migration_no_urgent_shed asserted
+    green. Replay any failure by pinning CHAOS_SEED."""
+    from dragonboat_tpu.tools.longhaul import Options, run_longhaul
+
+    seed = int(os.environ.get("CHAOS_SEED", "0") or "0", 0) or 0x5EED14
+    print(f"CHAOS SEED={seed:#x} (replay: CHAOS_SEED={seed:#x} pytest -m chaos)")
+    report = run_longhaul(
+        Options(
+            budget_s=60.0,
+            rounds_max=1,
+            round_s=5.0,
+            engine="vector",
+            out_dir=str(tmp_path / "lh"),
+            seed=seed,
+            rotate=False,
+            ring=False,
+            scenarios=("rebalance_under_load", "none"),
+        )
+    )
+    rounds = report["rounds"]
+    assert rounds, "no round ran"
+    res = rounds[0]
+    assert res.ok, (
+        f"seed {seed:#x} verdicts="
+        f"{sorted(k for k, v in res.verdicts.items() if not v)} "
+        f"error={res.error} bundle={res.bundle}"
+    )
+    assert res.scenarios.get("rebalance_under_load", 0) > 0, res.scenarios
+    # the migration verdicts actually fired
+    assert "migration_lincheck" in res.verdicts
+    assert "migration_no_urgent_shed" in res.verdicts
+
+
+@pytest.mark.chaos
 def test_rejoin_plane_scenario_family(tmp_path):
     """The rejoin-without-disruption scenario family in the `-m chaos`
     matrix: one seeded longhaul round restricted to

@@ -450,10 +450,12 @@ def test_scalar_engine_lane_stats_parity(tmp_path):
             "leader_id",
             "term",
             "commit_gap",
+            "last_index",
             "ticks_since_leader_change",
             "role",
             "payload_bytes",
         }
+        assert s["last_index"] >= s["commit_gap"]
         assert s["role"] == 2  # this single node leads
         assert s["payload_bytes"] >= 0
         assert s["node_id"] == 1

@@ -160,6 +160,33 @@ def test_refuses_steps_per_sync_mismatch():
     assert r["verdict"] == INCOMPARABLE
 
 
+def test_refuses_front_vs_raw_workload_mismatch():
+    """The through-front honesty rule (ISSUE 14, same shape as the K
+    refusal): an ADMITTED-throughput run (SessionManager/ServingFront in
+    the path) measures a different machine than a raw propose_batch run
+    — the diff refuses instead of reading the admission stack's cost as
+    a regression. A missing stamp means raw (the pre-front trajectory
+    keeps comparing), and session_mode alone implies through_front."""
+    a = load_record(BASE)["configs"]["1"]
+    b = json.loads(json.dumps(a))
+    b["workload"] = "through_front"
+    b["session_mode"] = "sessions"
+    b["placement_enabled"] = True
+    r = compare_config(a, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("workload" in s for s in r["reasons"])
+    r = compare_config(b, a)
+    assert r["verdict"] == INCOMPARABLE
+    # front-vs-front compares normally (the config-7 trajectory gates
+    # against itself)
+    b2 = json.loads(json.dumps(b))
+    assert compare_config(b, b2)["verdict"] == PASS
+    # a legacy record with only the session_mode stamp still refuses
+    legacy_front = json.loads(json.dumps(a))
+    legacy_front["session_mode"] = "sessions"
+    assert compare_config(a, legacy_front)["verdict"] == INCOMPARABLE
+
+
 def test_same_steps_per_sync_stays_comparable():
     """Two runs at the SAME K>1 diff normally (the K=8 trajectory can
     gate against itself), and a missing stamp means the classic K=1

@@ -328,6 +328,82 @@ def test_manager_session_dedup():
     assert sm.update_count == 2
 
 
+def test_rsm_retried_proposal_returns_cached_result_every_time():
+    """ISSUE 14 satellite: a deadline-retried proposal (same client,
+    same series) that already applied returns the CACHED result on
+    EVERY retry until the client acknowledges — one apply, identical
+    results, never the 'ignored' flag (the caller needs the payload)."""
+    mgr, sm, proxy = mk_manager()
+    run_tasks(
+        mgr, Task(entries=[entry(1, client=77, series=SERIES_ID_FOR_REGISTER)])
+    )
+    run_tasks(mgr, Task(entries=[entry(2, b"a=1", client=77, series=1)]))
+    first = proxy.updates[-1][1]
+    for idx in (3, 4, 5):  # three deadline retries of the SAME series
+        run_tasks(
+            mgr, Task(entries=[entry(idx, b"a=1", client=77, series=1)])
+        )
+        assert proxy.updates[-1][1] == first
+        assert not proxy.updates[-1][2]  # not rejected
+        assert not proxy.updates[-1][3]  # cached result, not 'ignored'
+    assert sm.update_count == 1
+    # the response cache really holds the unacknowledged series
+    s = mgr._sessions.get_registered_client(77)
+    assert s.get_response(1)[1]
+
+
+def test_rsm_eviction_honors_responded_to_advance():
+    """ISSUE 14 satellite: advancing responded_to EVICTS the cached
+    result (session.go:109-120 clearTo — the client promised never to
+    re-ask), and a late replay below the watermark reports
+    already-responded (ignored) rather than re-applying or answering
+    from a cache that no longer exists."""
+    mgr, sm, proxy = mk_manager()
+    run_tasks(
+        mgr, Task(entries=[entry(1, client=77, series=SERIES_ID_FOR_REGISTER)])
+    )
+    run_tasks(mgr, Task(entries=[entry(2, b"a=1", client=77, series=1)]))
+    s = mgr._sessions.get_registered_client(77)
+    assert s.get_response(1)[1]
+    # the next proposal carries responded_to=1: series 1's cache frees
+    run_tasks(
+        mgr,
+        Task(entries=[entry(3, b"b=2", client=77, series=2, responded=1)]),
+    )
+    assert sm.update_count == 2
+    assert s.responded_up_to == 1
+    assert not s.get_response(1)[1], "acknowledged result not evicted"
+    assert s.get_response(2)[1]  # the new series is cached
+    # a late replay of the acknowledged series: ignored, no third apply
+    run_tasks(
+        mgr,
+        Task(entries=[entry(4, b"a=zzz", client=77, series=1, responded=1)]),
+    )
+    assert proxy.updates[-1][3]  # ignored
+    assert sm.update_count == 2
+
+
+def test_rsm_expired_session_rejects_retry():
+    """ISSUE 14 satellite: a session evicted by the replicated LRU
+    (capacity pressure = session EXPIRY) REJECTS a retried proposal —
+    at-most-once cover is gone and the client must re-register, never
+    silently double-apply."""
+    mgr, sm, proxy = mk_manager()
+    mgr._sessions = SessionManager(max_sessions=1)
+    run_tasks(
+        mgr, Task(entries=[entry(1, client=77, series=SERIES_ID_FOR_REGISTER)])
+    )
+    run_tasks(mgr, Task(entries=[entry(2, b"a=1", client=77, series=1)]))
+    assert sm.update_count == 1
+    # registering a second client evicts 77 from the 1-slot LRU
+    run_tasks(
+        mgr, Task(entries=[entry(3, client=88, series=SERIES_ID_FOR_REGISTER)])
+    )
+    run_tasks(mgr, Task(entries=[entry(4, b"a=1", client=77, series=1)]))
+    assert proxy.updates[-1][2], "expired session's retry not rejected"
+    assert sm.update_count == 1, "expired session's retry re-applied"
+
+
 def test_manager_config_change():
     mgr, sm, proxy = mk_manager()
     cc = ConfigChange(

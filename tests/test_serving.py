@@ -331,6 +331,57 @@ def test_retry_retries_busy_until_success_honoring_hint():
     assert calls[1] < calls[0] and calls[2] < calls[1]
 
 
+def test_retry_propagates_session_same_series():
+    """ISSUE 14 satellite: call_with_retries(session=...) hands the SAME
+    session object to every attempt and the series id never advances
+    between retries — a retried proposal dedups against the original
+    apply instead of double-applying under an accidental new series."""
+    clk = FakeClock()
+    sess = Session.new_session(5)
+    sess.prepare_for_propose()
+    series0 = sess.series_id
+    attempts = []
+
+    def fn(remaining, session):
+        attempts.append((session, session.series_id))
+        if len(attempts) < 3:
+            raise ErrTenantThrottled(retry_after_s=0.01)
+        return "applied"
+
+    assert (
+        call_with_retries(
+            fn, 10.0, rng=random.Random(3),
+            clock=clk.now, sleep=clk.sleep, session=sess,
+        )
+        == "applied"
+    )
+    assert len(attempts) == 3
+    assert all(s is sess for s, _ in attempts)
+    assert {sid for _, sid in attempts} == {series0}, (
+        "a retry minted a new series"
+    )
+
+
+def test_retry_refuses_advanced_series_on_retryable_failure():
+    """If an attempt ADVANCED the session (it completed) and still
+    raised a retryable error, retrying would re-propose under a fresh
+    series — the one double-apply shape the session parameter exists to
+    prevent — so the helper refuses loudly instead of sleeping."""
+    clk = FakeClock()
+    sess = Session.new_session(5)
+    sess.prepare_for_propose()
+
+    def fn(remaining, session):
+        session.proposal_completed()  # buggy caller: acked mid-attempt
+        raise ErrTenantThrottled(retry_after_s=0.01)
+
+    with pytest.raises(RuntimeError, match="series advanced"):
+        call_with_retries(
+            fn, 10.0, rng=random.Random(3),
+            clock=clk.now, sleep=clk.sleep, session=sess,
+        )
+
+
 def test_retry_never_outlives_deadline():
     clk = FakeClock()
     sleeps = []
@@ -846,6 +897,14 @@ def test_bench_serving_report_schema_stable():
         "serving_urgent_p99_s",
         "serving_bulk_p50_s",
         "serving_bulk_p99_s",
+        # ISSUE 14: per-tenant latency + the session/migration ledger
+        # joined the ALWAYS-present fold (zero/empty when no front,
+        # placement plane or migration stream existed)
+        "serving_tenant_latency",
+        "migrations_started",
+        "migrations_completed",
+        "migrations_aborted",
+        "migration_streams",
     }
     assert keys == set(bench._serving_report({}))  # zero hosts
     host, front = _mk_front()
@@ -855,6 +914,8 @@ def test_bench_serving_report_schema_stable():
         host._serving = front
         r = bench._serving_report({1: host})
         assert r["serving_admitted_total"] == 1
+        assert r["migrations_started"] == 0
+        assert r["serving_tenant_latency"] == {}
     finally:
         front.stop()
 
