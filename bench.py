@@ -221,6 +221,7 @@ def bench_e2e(
     steps_per_sync: int = 1,
     through_front: bool = False,
     tenants: int = 0,
+    shard_over_mesh: bool = False,
 ):
     """N NodeHosts, G groups x N replicas, quorum + fsync + apply.
 
@@ -259,7 +260,7 @@ def bench_e2e(
             hosts, members, reg, sm_cls, groups, duration_s, payload,
             workdir, shared, wave, inbox_depth, entries_per_msg, log_window,
             replicas, read_ratio, drop_rate, churn, steps_per_sync,
-            through_front, tenants,
+            through_front, tenants, shard_over_mesh,
         )
     finally:
         # an exception must not leak NodeHosts: the share_scope='bench'
@@ -276,7 +277,7 @@ def _bench_e2e_body(
     hosts, members, reg, sm_cls, groups, duration_s, payload, workdir,
     shared, wave, inbox_depth, entries_per_msg, log_window, replicas,
     read_ratio, drop_rate, churn, steps_per_sync=1, through_front=False,
-    tenants=0,
+    tenants=0, shard_over_mesh=False,
 ):
     import random as _random
 
@@ -307,6 +308,7 @@ def _bench_e2e_body(
                 inbox_depth=inbox_depth,
                 max_entries_per_msg=entries_per_msg,
                 steps_per_sync=steps_per_sync,
+                shard_over_mesh=shard_over_mesh,
                 share_scope=(
                     f"bench-k{steps_per_sync}" if shared else None
                 ),
@@ -317,20 +319,6 @@ def _bench_e2e_body(
             ),
         )
         hosts[nid] = NodeHost(cfg)
-    if drop_rate > 0 and shared:
-        # randomized replication drops over the co-hosted path (the wire
-        # analogue is the transport pre-send hook); rejects/backoff and
-        # re-replication must recover the divergence
-        rnd = _random.Random(1234)
-        rep_types = (
-            MessageType.REPLICATE,
-            MessageType.REPLICATE_RESP,
-        )
-
-        def _drop(m, _rnd=rnd, _t=rep_types):
-            return m.type in _t and _rnd.random() < drop_rate
-
-        hosts[1].engine.core.set_local_drop_hook(_drop)
     for nid in members:
         hosts[nid].start_clusters([
             (
@@ -350,7 +338,20 @@ def _bench_e2e_body(
     leaders = {}
     pending = set(range(1, groups + 1))
     snap_fn = getattr(hosts[1].engine, "leader_snapshot", None)
-    while pending and time.monotonic() - t0 < 180:
+    # the bring-up budget scales with fleet size: a 250k-lane nominal
+    # config legitimately needs minutes of elections on one host, and a
+    # fixed 180s would fail it before the ladder's watchdog even matters
+    election_wait = max(180.0, 0.004 * groups * replicas)
+    if shard_over_mesh:
+        # the sharded engine's bring-up is paced in LAUNCHES: the tick
+        # plane clamps each launch's burst at the heartbeat RTT, so a
+        # timeout expires after ~election_rtt/heartbeat_rtt launches no
+        # matter the wall clock, and the split-vote tail across 10k+
+        # independent clusters adds several re-election rounds on top.
+        # Each launch pays the replicated cross-shard router: ~25-30s
+        # at 50k lanes on 2 virtual CPU devices, linear in lanes.
+        election_wait = max(1800.0, 0.04 * groups * replicas)
+    while pending and time.monotonic() - t0 < election_wait:
         if snap_fn is not None:
             snap = snap_fn()
             for c in list(pending):
@@ -375,8 +376,28 @@ def _bench_e2e_body(
             "value": 0.0,
             "steps_per_sync": steps_per_sync,
         }
+        err.update(_mesh_report(hosts, shard_over_mesh))
         err.update(_attribution_report(hosts, None, None))
         return err
+    if drop_rate > 0 and shared:
+        # randomized replication drops over the co-hosted path (the wire
+        # analogue is the transport pre-send hook); rejects/backoff and
+        # re-replication must recover the divergence. Installed AFTER
+        # bring-up: the stress targets replication during the measured
+        # window, and a hook forces the multi-step engine off on-device
+        # routing (every message must pass the host-side predicate) —
+        # pre-install would put the election traffic on the slow path
+        # for no measurement gain.
+        rnd = _random.Random(1234)
+        rep_types = (
+            MessageType.REPLICATE,
+            MessageType.REPLICATE_RESP,
+        )
+
+        def _drop(m, _rnd=rnd, _t=rep_types):
+            return m.type in _t and _rnd.random() < drop_rate
+
+        hosts[1].engine.core.set_local_drop_hook(_drop)
     # warmup: the first kernel compile stalls every engine and piles ticks;
     # the resulting election churn settles within ~2s. Measuring through it
     # records churn losses, not steady-state throughput.
@@ -398,6 +419,7 @@ def _bench_e2e_body(
             hosts, leaders, snap_fn, groups, duration_s, cmd, wave,
             max(tenants, 1), bring_up_s, steps_per_sync,
         )
+        out.update(_mesh_report(hosts, shard_over_mesh))
         out.update(_host_stage_report(hosts))
         out.update(_attribution_report(hosts, sync_mark, compile_mark))
         out.update(_latency_report(hosts))
@@ -521,6 +543,7 @@ def _bench_e2e_body(
         # different machines, like scaled-down vs nominal does)
         "steps_per_sync": steps_per_sync,
     }
+    out.update(_mesh_report(hosts, shard_over_mesh))
     if read_ratio:
         out["reads_completed"] = reads_done
         out["reads_submitted"] = reads_submitted
@@ -866,6 +889,28 @@ def _latency_report(hosts) -> dict:
 _FANOUT_STAGES = ("place", "send_rep", "send_resp", "apply", "reads")
 
 
+def _mesh_report(hosts, shard_over_mesh: bool) -> dict:
+    """Mesh honesty stamps for every config JSON: how many devices the
+    engine actually sharded over (1 = unsharded), the mesh shape, and the
+    ghost-lane count from the device-multiple round-up. tools.perfdiff
+    refuses to diff configs whose mesh shapes differ, exactly like the
+    scaled-down / K / workload refusals."""
+    n_dev, padded = 0, 0
+    try:
+        ss = hosts[1].engine.step_stats()
+        n_dev = int(ss.get("mesh_devices", 0) or 0)
+        padded = int(ss.get("padded_groups", 0) or 0)
+    except Exception:
+        pass
+    n_dev = n_dev or 1
+    return {
+        "shard_over_mesh": bool(shard_over_mesh),
+        "n_devices": n_dev,
+        "mesh_shape": [n_dev],
+        "padded_groups": padded,
+    }
+
+
 def _host_stage_report(hosts) -> dict:
     """Per-stage host timings from the engine's stage profiler: total
     seconds per stage (pack / device dispatch+step / fan-out / save) plus
@@ -1014,6 +1059,7 @@ def _run_ladder_config(
         r = bench_e2e(
             groups, duration, spec["payload"], workdir,
             wave=spec["wave"],
+            entries_per_msg=spec.get("entries_per_msg", 64),
             replicas=spec["replicas"],
             read_ratio=spec.get("read_ratio", 0),
             drop_rate=spec.get("drop_rate", 0.0),
@@ -1021,6 +1067,7 @@ def _run_ladder_config(
             steps_per_sync=spec.get("steps_per_sync", 1),
             through_front=spec.get("through_front", False),
             tenants=spec.get("tenants", 0),
+            shard_over_mesh=spec.get("shard_over_mesh", False),
         )
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1033,6 +1080,7 @@ def _run_ladder_config(
     r["nominal_groups"] = spec["nominal_groups"]
     r["actual_groups"] = groups
     r["scaled_down"] = groups != spec["nominal_groups"]
+    r["entries_per_msg"] = spec.get("entries_per_msg", 64)
     return r
 
 
@@ -1047,6 +1095,21 @@ def main() -> None:
     ap.add_argument("--steps-per-sync", type=int, default=0,
                     help="override EngineConfig.steps_per_sync (with "
                          "--config): K protocol steps per kernel launch")
+    ap.add_argument("--shard-over-mesh", action="store_true",
+                    help="shard the engine's lane axis over every visible "
+                         "device (EngineConfig.shard_over_mesh); composes "
+                         "with --steps-per-sync")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="pin N virtual CPU devices before backend init "
+                         "(XLA host-platform device count; CPU only — on "
+                         "an accelerator the real topology is used)")
+    ap.add_argument("--entries-per-msg", type=int, default=0,
+                    help="override the e2e engine's max_entries_per_msg "
+                         "(with --config). The cross-shard router ships "
+                         "2*E entry rows per candidate message, so E "
+                         "dominates the routed-slab width; sharded CPU "
+                         "runs use E=8 to keep the per-launch cost sane. "
+                         "Stamped into the config record.")
     ap.add_argument("--duration", type=float, default=0.0)
     ap.add_argument("--kernel-groups", type=int, default=50_000)
     ap.add_argument("--kernel-steps", type=int, default=50)
@@ -1057,6 +1120,12 @@ def main() -> None:
     ap.add_argument("--watchdog-s", type=float, default=560.0)
     args = ap.parse_args()
 
+    if args.devices > 0:
+        # must land before anything touches the backend: XLA reads the
+        # host-platform device count at first initialization only
+        from dragonboat_tpu._jaxenv import pin_cpu
+
+        pin_cpu(n_devices=args.devices)
     platform = _ensure_live_backend(
         max_wait_s=60.0 if args.config else 300.0
     )
@@ -1101,6 +1170,10 @@ def main() -> None:
                     spec["duration"] = args.duration
                 if args.steps_per_sync:
                     spec["steps_per_sync"] = args.steps_per_sync
+                if args.shard_over_mesh:
+                    spec["shard_over_mesh"] = True
+                if args.entries_per_msg:
+                    spec["entries_per_msg"] = args.entries_per_msg
             try:
                 configs[str(n)] = _run_ladder_config(
                     n, spec, cpu,

@@ -454,13 +454,25 @@ def _default_targets() -> Targets:
         },
         device_roots={"self._state"},
         traced_modules={KERNEL},
-        traced_exempt={"make_step_fn", "make_multi_step_fn"},
+        traced_exempt={
+            "make_step_fn",
+            "make_multi_step_fn",
+            # the sharded twin: shard_map + jit factory (same contract)
+            "make_sharded_multi_step_fn",
+            # host-side backend/env probe deciding Pallas ring vs XLA
+            # all-gather — runs at trace time, not inside the kernel
+            "_pallas_route_active",
+        },
         traced_functions={(VECTOR, "_make_activate_fn.apply")},
         # `steps` is the super-step scan length: a compile-time constant
         # baked into the executable by make_multi_step_fn (a traced K
         # would rebuild the scan per value — the retrace family's
-        # recompile-hazard meta-test covers exactly this)
-        static_param_names={"cfg", "donate", "steps"},
+        # recompile-hazard meta-test covers exactly this). The sharded
+        # factory additionally bakes the mesh and the cross-shard axis
+        # (axis_name/n_shards): all compile-time topology, never traced.
+        static_param_names={
+            "cfg", "donate", "steps", "mesh", "axis_name", "n_shards",
+        },
         locks=locks,
         lock_var_hints={
             "node": "Node",

@@ -85,8 +85,15 @@ class EngineConfig:
     kind: str = "vector"
     # Shard the engine's (G, ...) state over every visible jax device
     # (jax.sharding.Mesh along the group axis). Groups are independent
-    # Raft instances, so the kernel partitions with no cross-device
-    # collectives on the hot path.
+    # Raft instances, so at steps_per_sync=1 the kernel partitions with
+    # no cross-device collectives on the hot path. Composed with
+    # steps_per_sync>1 the inter-step router exchanges candidate
+    # messages across shards inside the launch (Pallas async remote DMA
+    # ring on TPU, XLA all-gather elsewhere; DBTPU_PALLAS_ROUTE=0 forces
+    # the collective) so co-hosted replicas on different chips still
+    # talk without the host. max_groups is rounded up to a device
+    # multiple; the round-up is stamped in step_stats
+    # (padded_groups/mesh_devices) and ghost lanes are never allocated.
     shard_over_mesh: bool = False
     # Max Raft groups per NodeHost; the G dimension of the kernel tensors.
     # (Default sized for fast bring-up; large fleets raise it explicitly.)
@@ -121,7 +128,9 @@ class EngineConfig:
     # reads, ticks) enter only at super-step boundaries, so client
     # completion latency grows with K while dispatch/fetch host wall
     # shrinks by ~K. K must be a static int (it is compiled into the
-    # scan length); incompatible with shard_over_mesh for now.
+    # scan length). Composes with shard_over_mesh: the sharded K-step
+    # kernel routes cross-shard lane traffic device-to-device between
+    # inner steps and stays bit-identical to the unsharded reference.
     steps_per_sync: int = 1
     # Pipeline the engine loop: dispatch kernel step t, then decode step
     # t-1's output while the device computes. Removes the device wait from

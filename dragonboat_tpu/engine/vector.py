@@ -84,7 +84,11 @@ from ..core.peer import PeerAddress, encode_config_change
 from ..core.raft import _make_metadata_entries, _make_witness_snapshot
 from ..core.rate import ENTRY_OVERHEAD_BYTES
 from ..logger import get_logger
-from ..ops.kernel import make_multi_step_fn, make_step_fn
+from ..ops.kernel import (
+    make_multi_step_fn,
+    make_sharded_multi_step_fn,
+    make_step_fn,
+)
 from ..ops.state import (
     MSG,
     NEED_SNAPSHOT,
@@ -129,6 +133,27 @@ from .fairness import FairnessWatchdog
 from .node import Node
 
 _plog = get_logger("vectorengine")
+
+# One sharded collective program in flight per process: the K>1 mesh
+# kernel contains cross-shard exchanges (all-gather / Pallas ring), and
+# concurrent launches from co-hosted engines interleave their rendezvous
+# on the shared per-device executors — the CPU backend stalls its
+# participant threads outright. Production runs one engine per host, so
+# serializing launches costs nothing there; multi-NodeHost-in-process
+# tests pay a fair round-robin. K=1 sharded and every unsharded path
+# have no collectives and never take this lock.
+_MESH_LAUNCH_MU = threading.Lock()
+
+
+class _NoLock:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NO_LOCK = _NoLock()
 
 MT = MessageType
 
@@ -981,6 +1006,10 @@ class VectorEngine:
         # kernel partitions along G with zero collectives on the hot path)
         self._sharding = None
         self._inbox_shardings = None  # cached pytree; shapes never change
+        self._multi_shardings = None  # K>1 twin: (inbox, ticks, route, rdelta)
+        self._mesh = None
+        self._mesh_devices = 0  # 0 = unsharded single-device engine
+        groups_requested = self.kcfg.groups
         if (
             ecfg is not None
             and getattr(ecfg, "shard_over_mesh", False)
@@ -991,10 +1020,18 @@ class VectorEngine:
             devs = jax.devices()
             n = len(devs)
             if self.kcfg.groups % n:
+                # round UP to a device multiple so every shard holds the
+                # same block. NOT silent: the shortfall is stamped in
+                # step_stats (padded_groups/mesh_devices -> engine_step_*
+                # gauges + bench JSON) and the ghost lanes are never
+                # handed out by the allocator, so lane_stats never
+                # reports them
                 self.kcfg = self.kcfg._replace(
                     groups=((self.kcfg.groups + n - 1) // n) * n
                 )
             mesh = Mesh(np.array(devs), ("groups",))
+            self._mesh = mesh
+            self._mesh_devices = n
 
             def _shard_for(x, _mesh=mesh, _NS=NamedSharding, _P=PartitionSpec):
                 return _NS(
@@ -1002,6 +1039,8 @@ class VectorEngine:
                 )
 
             self._sharding = _shard_for
+        self._groups_requested = groups_requested
+        self._padded_groups = self.kcfg.groups - groups_requested
         self.clock = _SharedClock()
         # device-resident multi-step: K protocol steps per kernel launch
         # (EngineConfig.steps_per_sync). K=1 keeps the classic one-step
@@ -1011,11 +1050,6 @@ class VectorEngine:
             if ecfg
             else 1
         )
-        if self._multi > 1 and self._sharding is not None:
-            raise ValueError(
-                "steps_per_sync > 1 is not supported with shard_over_mesh: "
-                "on-device lane routing crosses shard boundaries"
-            )
         ov = getattr(ecfg, "overlap_decode", None) if ecfg else None
         if ov is None:
             ov = jax.default_backend() != "cpu"  # auto: see EngineConfig
@@ -1060,6 +1094,12 @@ class VectorEngine:
             # multi-step engine: co-hosted messages routed ON DEVICE
             # between inner steps (zero host Message objects each)
             "msgs_routed_device": 0,
+            # sharded mesh: ghost lanes added by the device-multiple
+            # round-up (never allocated) and the mesh width — static
+            # stamps, not counters, so bench JSON and gauges can tell a
+            # padded sharded run from an exact one
+            "padded_groups": self._padded_groups,
+            "mesh_devices": self._mesh_devices,
         }
         # ---- tick-fairness watchdog (ROADMAP seed flake) -----------------
         # Inter-iteration latency vs the host's tick period, a starvation
@@ -1109,14 +1149,31 @@ class VectorEngine:
         self._pending_rep_copies: list = []
         self._routes_dirty = True
         if self._multi > 1:
-            self._multi_fn = make_multi_step_fn(self.kcfg, self._multi)
+            if self._mesh is not None:
+                # K-step kernel over the mesh: cross-shard lane traffic
+                # moves device-to-device inside the launch (Pallas ring
+                # on TPU, all-gather elsewhere); the host path stays the
+                # fallback for lanes the route table marks -1
+                self._multi_fn = make_sharded_multi_step_fn(
+                    self.kcfg, self._multi, self._mesh
+                )
+                name = f"multi_step[g{G}.k{self._multi}.d{self._mesh_devices}]"
+            else:
+                self._multi_fn = make_multi_step_fn(self.kcfg, self._multi)
+                name = f"multi_step[g{G}.k{self._multi}]"
             # no comma in the name: it becomes a Prometheus label value
-            compile_watch().register(
-                f"multi_step[g{G}.k{self._multi}]", self._multi_fn
-            )
+            compile_watch().register(name, self._multi_fn)
             self._np_route = np.full((G, self.kcfg.peers), -1, np.int32)
             self._np_rdelta = np.zeros((G, self.kcfg.peers), np.int32)
-            self._resid = jax.device_put(make_empty_inbox(self.kcfg))
+            resid = make_empty_inbox(self.kcfg)
+            if self._sharding is not None:
+                # the residual inbox must live on the mesh like the rest
+                # of the lane state, or every launch would reshard it
+                self._resid = jax.device_put(
+                    resid, jax.tree_util.tree_map(self._sharding, resid)
+                )
+            else:
+                self._resid = jax.device_put(resid)
         self._state: RaftTensors = init_state(self.kcfg)
         if self._sharding is not None:
             self._state = jax.tree.map(
@@ -1128,7 +1185,10 @@ class VectorEngine:
         self._lanes: Dict[tuple, _Lane] = {}
         # (cluster_id, node_id) -> lane, for in-core message short-circuit
         self._route: Dict[tuple, _Lane] = {}
-        self._free = list(range(self.kcfg.groups - 1, -1, -1))
+        # ghost lanes from the sharded round-up are NOT capacity: the
+        # allocator only hands out the lanes the caller configured, so
+        # padded lanes never reach _lanes / lane_stats / gauges
+        self._free = list(range(self._groups_requested - 1, -1, -1))
         self._lanes_mu = threading.RLock()
         self._reconq: deque = deque()  # host->device ops, loop-applied
         self._stopped = threading.Event()
@@ -1240,6 +1300,12 @@ class VectorEngine:
                 jax.tree_util.tree_map(self._sharding, self._host_inbox),
                 self._sharding(self._ticks),
             )
+            if self._multi > 1:
+                # the K>1 transfer also ships the route/delta planes
+                self._multi_shardings = self._inbox_shardings + (
+                    self._sharding(self._np_route),
+                    self._sharding(self._np_rdelta),
+                )
 
     def _alloc_mirrors(self) -> None:
         """Whole-G numpy mirrors of per-lane protocol state, refreshed from
@@ -1609,19 +1675,27 @@ class VectorEngine:
             # K protocol steps per launch: the route/delta planes ride
             # the same batched transfer (small G x P arrays; rebuilt
             # host-side only when lane topology changes)
-            inbox, tarr, route, rdelta = jax.device_put(
-                (
-                    self._host_inbox, self._ticks,
-                    self._np_route, self._np_rdelta,
-                )
+            payload = (
+                self._host_inbox, self._ticks,
+                self._np_route, self._np_rdelta,
             )
-            self._state, outs, plans, self._resid, resid_count = (
-                self._multi_fn(
-                    self._state, inbox, tarr, self._resid, route, rdelta
+            # lint: allow(locks/lock-in-hot-loop) _MESH_LAUNCH_MU —
+            # uncontended with one engine per process; see its comment
+            mu = _MESH_LAUNCH_MU if self._mesh is not None else _NO_LOCK
+            with mu:
+                if self._multi_shardings is not None:
+                    inbox, tarr, route, rdelta = jax.device_put(
+                        payload, self._multi_shardings
+                    )
+                else:
+                    inbox, tarr, route, rdelta = jax.device_put(payload)
+                self._state, outs, plans, self._resid, resid_count = (
+                    self._multi_fn(
+                        self._state, inbox, tarr, self._resid, route, rdelta
+                    )
                 )
-            )
-            prof.end("dispatch")
-            o, pl, rc = self._fetch_super(outs, plans, resid_count)
+                prof.end("dispatch")
+                o, pl, rc = self._fetch_super(outs, plans, resid_count)
             self._m_resid = rc
             self._decode_super(work, packs, o, pl)
             return
@@ -4119,7 +4193,9 @@ def get_vector_engine(logdb, nh_config: NodeHostConfig) -> VectorEngineHandle:
             mismatches = [
                 name
                 for name, got, exp in (
-                    ("max_groups", core.kcfg.groups, want.max_groups),
+                    # requested, not kcfg.groups: the sharded round-up
+                    # pads the kernel shape, not the declared capacity
+                    ("max_groups", core._groups_requested, want.max_groups),
                     ("max_peers", core.kcfg.peers, want.max_peers),
                     ("log_window", core.kcfg.log_window, want.log_window),
                     ("inbox_depth", core.kcfg.inbox_depth, want.inbox_depth),

@@ -25,6 +25,7 @@ update for every lane and reality is selected by masks. This trades FLOPs
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -1194,6 +1195,22 @@ def route_step_output(
     like a full receive queue does."""
     G, P = s.member.shape
     K = cfg.inbox_depth
+    R = cfg.readindex_depth
+    dest, fields, efields = _route_columns(s, out, route, rdelta, cfg)
+    nxt, routed = _route_scatter(dest, fields, efields, G, K)
+    return nxt, _split_plan(routed, G, P, K, R)
+
+
+def _route_columns(s: RaftTensors, out: StepOutput, route, rdelta, cfg):
+    """The router's candidate planes, flattened kind-major then row-major
+    (the host dispatch order). Returns (dest, fields, (entry_terms,
+    entry_cc)): ``dest`` is the destination lane per candidate (-1 = not
+    a candidate), ``fields`` the ten scalar message columns in Inbox
+    staging order, and the entry planes carry Replicate payload metadata.
+    Lane indexes in ``route``/``dest`` are GLOBAL — on a sharded mesh a
+    local block emits candidates addressed across the whole fleet."""
+    G, P = s.member.shape
+    K = cfg.inbox_depth
     E = cfg.max_entries_per_msg
     R = cfg.readindex_depth
     W = s.log_term.shape[1]
@@ -1330,7 +1347,25 @@ def route_step_output(
         return jnp.concatenate([k[col].reshape(-1, E) for k in kinds])
 
     dest = jnp.where(cat(0), cat(1), -1)
+    fields = tuple(cat(c) for c in range(2, 12))
+    return dest, fields, (cat_e(12), cat_e(13))
+
+
+def _route_segments(P: int, K: int, R: int) -> Tuple[int, ...]:
+    """Per-kind candidate counts PER LANE ROW in the flattened kind-major
+    layout (rep, vote, hb, tn, resp, rir). A G-lane block contributes
+    ``G * seg`` candidates per kind; the sharded router uses this to
+    splice per-shard segments back into the global kind-major order."""
+    return (P, P, P, P, K, R)
+
+
+def _route_scatter(dest, fields, efields, G: int, K: int):
+    """Stable-sort the flattened candidates by destination lane and
+    scatter the first K arrivals per destination into a fresh Inbox.
+    Returns (inbox, routed) where ``routed`` is the flat per-candidate
+    accepted mask in the ORIGINAL (pre-sort) candidate order."""
     M = dest.shape[0]
+    E = efields[0].shape[1]
     key = jnp.where(dest >= 0, dest, G)
     order = jnp.argsort(key, stable=True)
     skey = key[order]
@@ -1344,22 +1379,28 @@ def route_step_output(
         return init.at[row, col].set(vals[order], mode="drop")
 
     nxt = Inbox(
-        mtype=scat(jnp.full((G, K), MSG.NONE, i32), cat(2)),
-        from_slot=scat(jnp.zeros((G, K), i32), cat(3)),
-        term=scat(jnp.zeros((G, K), i32), cat(4)),
-        log_index=scat(jnp.zeros((G, K), i32), cat(5)),
-        log_term=scat(jnp.zeros((G, K), i32), cat(6)),
-        commit=scat(jnp.zeros((G, K), i32), cat(7)),
-        reject=scat(jnp.zeros((G, K), bool), cat(8)),
-        hint=scat(jnp.zeros((G, K), i32), cat(9)),
-        hint_high=scat(jnp.zeros((G, K), i32), cat(10)),
-        n_entries=scat(jnp.zeros((G, K), i32), cat(11)),
-        entry_terms=scat(jnp.zeros((G, K, E), i32), cat_e(12)),
-        entry_cc=scat(jnp.zeros((G, K, E), bool), cat_e(13)),
+        mtype=scat(jnp.full((G, K), MSG.NONE, i32), fields[0]),
+        from_slot=scat(jnp.zeros((G, K), i32), fields[1]),
+        term=scat(jnp.zeros((G, K), i32), fields[2]),
+        log_index=scat(jnp.zeros((G, K), i32), fields[3]),
+        log_term=scat(jnp.zeros((G, K), i32), fields[4]),
+        commit=scat(jnp.zeros((G, K), i32), fields[5]),
+        reject=scat(jnp.zeros((G, K), bool), fields[6]),
+        hint=scat(jnp.zeros((G, K), i32), fields[7]),
+        hint_high=scat(jnp.zeros((G, K), i32), fields[8]),
+        n_entries=scat(jnp.zeros((G, K), i32), fields[9]),
+        entry_terms=scat(jnp.zeros((G, K, E), i32), efields[0]),
+        entry_cc=scat(jnp.zeros((G, K, E), bool), efields[1]),
     )
     routed = jnp.zeros((M,), bool).at[order].set(ok)
+    return nxt, routed
+
+
+def _split_plan(routed, G: int, P: int, K: int, R: int) -> RoutePlan:
+    """Reshape the flat accepted mask back into per-kind RoutePlan planes
+    (inverse of the kind-major flattening in _route_columns)."""
     gp, gk = G * P, G * K
-    plan = RoutePlan(
+    return RoutePlan(
         rep=routed[0:gp].reshape(G, P),
         vote=routed[gp : 2 * gp].reshape(G, P),
         hb=routed[2 * gp : 3 * gp].reshape(G, P),
@@ -1367,7 +1408,6 @@ def route_step_output(
         resp=routed[4 * gp : 4 * gp + gk].reshape(G, K),
         rir=routed[4 * gp + gk :].reshape(G, R),
     )
-    return nxt, plan
 
 
 def multi_step_batch(
@@ -1432,3 +1472,247 @@ def make_multi_step_fn(cfg: KernelConfig, steps: int, donate: bool = True):
     if donate:
         return jax.jit(f, donate_argnums=(0, 3))
     return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# sharded multi-step: the K-step kernel over an N-device mesh, with
+# cross-shard lane traffic routed device-to-device between inner steps
+# ---------------------------------------------------------------------------
+
+
+def _pallas_route_active() -> bool:
+    """Whether the cross-shard candidate exchange should use the Pallas
+    async-remote-DMA ring instead of the XLA all-gather collective. On by
+    default on TPU backends; ``DBTPU_PALLAS_ROUTE=0`` is the escape hatch
+    back to the collective (e.g. a TPU generation where the ring kernel
+    misbehaves). Non-TPU backends always use the collective — Pallas
+    remote DMA is a TPU primitive."""
+    if os.environ.get("DBTPU_PALLAS_ROUTE", "auto") == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _pallas_ring_gather(x: jax.Array, axis_name: str, n_shards: int):
+    """All-gather the per-shard candidate slab ``x`` (C, M) over the mesh
+    ring with Pallas async remote DMA -> (n_shards, C, M). Follows the
+    distributed-guide ring all-gather: neighbor barrier, then n-1 hops of
+    double-buffered RDMA, each device forwarding the slab it just
+    received to its right neighbor. Byte-identical to lax.all_gather
+    (same values, same order) — only the transport differs."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = n_shards
+    C, M = x.shape
+
+    def kern(local_ref, out_ref, comm_ref, send_sem, recv_sem):
+        my = jax.lax.axis_index(axis_name)
+        left = jax.lax.rem(my + n - 1, n)
+        right = jax.lax.rem(my + 1, n)
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=(left,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        pltpu.semaphore_wait(barrier, 2)
+        out_ref[pl.ds(my, 1)] = local_ref[:][None]
+        comm_ref[0] = local_ref[:]
+        for step in range(n - 1):
+            send_slot = step % 2
+            recv_slot = (step + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[send_slot],
+                dst_ref=comm_ref.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=(right,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            src = jax.lax.rem(my + n - step - 1, n)
+            out_ref[pl.ds(src, 1)] = comm_ref[recv_slot][None]
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, C, M), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, M), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+    )(x)
+
+
+def _gather_candidates(x: jax.Array, axis_name: str, n_shards: int):
+    """(C, M) per-shard slab -> (n_shards, C, M), shard-major. The Pallas
+    ring on TPU, the XLA collective everywhere else (and under the
+    DBTPU_PALLAS_ROUTE=0 escape hatch)."""
+    if _pallas_route_active():
+        return _pallas_ring_gather(x, axis_name, n_shards)
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=False)
+
+
+def _shard_route(
+    s: RaftTensors,
+    out: StepOutput,
+    route: jax.Array,
+    rdelta: jax.Array,
+    cfg: KernelConfig,
+    axis_name: str,
+    n_shards: int,
+) -> Tuple[Inbox, RoutePlan]:
+    """route_step_output for a LOCAL shard block running under shard_map:
+    every shard's candidate planes are exchanged across the mesh (Pallas
+    ring on TPU, all-gather otherwise), each shard replays the identical
+    global stable-sort scatter, then keeps only its own rows of the
+    resulting inbox and its own candidates' bits of the plan.
+
+    ``route`` holds GLOBAL lane indexes, so a candidate whose destination
+    lane lives on another shard lands in that shard's inbox rows without
+    touching the host. Replaying the global scatter on every shard is
+    redundant compute but buys determinism: all shards agree on arrival
+    order by construction, so the result is byte-identical to the
+    unsharded router on the concatenated state."""
+    Gl, P = s.member.shape
+    K = cfg.inbox_depth
+    R = cfg.readindex_depth
+    E = cfg.max_entries_per_msg
+    n = n_shards
+    G = n * Gl
+    dest, fields, efields = _route_columns(s, out, route, rdelta, cfg)
+
+    # pack dest + the 10 scalar columns + the 2E entry columns into one
+    # i32 slab so the cross-shard exchange is a single transfer
+    cols = [dest] + [f.astype(i32) for f in fields]
+    slab = jnp.concatenate(
+        [jnp.stack(cols)] + [ef.astype(i32).T for ef in efields]
+    )  # (C, Ml): dest, 10 scalar rows, then E entry_terms + E entry_cc rows
+    g = _gather_candidates(slab, axis_name, n)  # (n, C, Ml)
+
+    # splice per-shard segments back into the GLOBAL kind-major layout:
+    # within one kind, shard-major == global row-major because shards
+    # hold contiguous lane blocks
+    segs = _route_segments(P, K, R)
+    parts, off = [], 0
+    for seg in segs:
+        L = Gl * seg
+        parts.append(jnp.swapaxes(g[:, :, off : off + L], 0, 1).reshape(
+            g.shape[1], n * L
+        ))
+        off += L
+    gcols = jnp.concatenate(parts, axis=1)  # (C, Mg)
+    gdest = gcols[0]
+    gfields = list(gcols[1 : 11])
+    gfields[6] = gfields[6].astype(bool)  # reject
+    ge_terms = jnp.stack([gcols[11 + e] for e in range(E)], axis=1)
+    ge_cc = jnp.stack(
+        [gcols[11 + E + e] for e in range(E)], axis=1
+    ).astype(bool)
+
+    nxt_g, routed_g = _route_scatter(
+        gdest, tuple(gfields), (ge_terms, ge_cc), G, K
+    )
+
+    # keep this shard's slice: inbox rows by lane block, plan bits by
+    # per-kind candidate block
+    my = jax.lax.axis_index(axis_name)
+    nxt = jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, my * Gl, Gl, 0), nxt_g
+    )
+    lparts, goff = [], 0
+    for seg in segs:
+        L = Gl * seg
+        lparts.append(jax.lax.dynamic_slice(routed_g, (goff + my * L,), (L,)))
+        goff += n * L
+    routed = jnp.concatenate(lparts)
+    return nxt, _split_plan(routed, Gl, P, K, R)
+
+
+def sharded_multi_step_batch(
+    s: RaftTensors,
+    inbox: Inbox,
+    ticks: jax.Array,
+    resid: Inbox,
+    route: jax.Array,
+    rdelta: jax.Array,
+    cfg: KernelConfig,
+    steps: int,
+    axis_name: str,
+    n_shards: int,
+):
+    """multi_step_batch on a LOCAL shard block: step_batch is lane-local
+    (every shape derives from the arrays, never from cfg.groups), so it
+    runs unchanged on the block; only the inter-step router needs the
+    cross-shard exchange. Same contract and same results as the
+    unsharded kernel on the concatenated state."""
+    occ = resid.mtype != MSG.NONE
+
+    def mg(r, h):
+        m = occ
+        while m.ndim < r.ndim:
+            m = m[..., None]
+        return jnp.where(m, r, h)
+
+    inbox0 = jax.tree.map(mg, resid, inbox)
+
+    def body(carry, _):
+        st, ibx, tks = carry
+        st, out = step_batch(st, ibx, tks, cfg)
+        nxt, plan = _shard_route(
+            st, out, route, rdelta, cfg, axis_name, n_shards
+        )
+        return (st, nxt, jnp.zeros_like(tks)), (out, plan)
+
+    (s, resid_out, _), (outs, plans) = jax.lax.scan(
+        body, (s, inbox0, ticks), None, length=steps
+    )
+    resid_count = jnp.sum(resid_out.mtype != MSG.NONE, axis=1).astype(i32)
+    return s, outs, plans, resid_out, resid_count
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_multi_step_fn(
+    cfg: KernelConfig, steps: int, mesh, donate: bool = True
+):
+    """Jitted sharded multi_step(state, inbox, ticks, resid, route,
+    rdelta) -> (state, outs, plans, resid, resid_count) with every lane
+    axis sharded over ``mesh``'s single "groups" axis via shard_map.
+    cfg.groups must be a multiple of the mesh size (the engine pads).
+    Cached per (cfg, steps, mesh, donate) — jax.sharding.Mesh hashes by
+    device set + axis names, so engines on the same mesh share the
+    executable exactly like the unsharded factories."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    body = functools.partial(
+        sharded_multi_step_batch,
+        cfg=cfg, steps=steps, axis_name=axis, n_shards=n,
+    )
+    lane = PartitionSpec(axis)
+    step_lane = PartitionSpec(None, axis)  # (K, G, ...) stacked outputs
+    sm = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(lane,) * 6,
+        out_specs=(lane, step_lane, step_lane, lane, lane),
+        check_rep=False,
+    )
+    in_sh = NamedSharding(mesh, lane)
+    out_sh = NamedSharding(mesh, step_lane)
+    kw = dict(
+        in_shardings=(in_sh,) * 6,
+        out_shardings=(in_sh, out_sh, out_sh, in_sh, in_sh),
+    )
+    if donate:
+        return jax.jit(sm, donate_argnums=(0, 3), **kw)
+    return jax.jit(sm, **kw)

@@ -155,6 +155,12 @@ class Options:
     ring: bool = False  # attach a per-round crash-persistent mmap ring
     inject_failure: bool = False  # force a failing verdict (bundle drill)
     scenarios: tuple = SCENARIOS
+    # vector-engine composition knobs: the smoke rotation soaks the
+    # sharded K-step kernel (shard_over_mesh + steps_per_sync>1) under
+    # the same chaos schedule as the host path — scalar engines ignore
+    # both
+    steps_per_sync: int = 1
+    shard_over_mesh: bool = False
 
 
 def _round_seed(master: int, round_no: int, rotate: bool) -> int:
@@ -168,7 +174,7 @@ def _mk_host(
     nid: int,
     reg: _Registry,
     run_dir: str,
-    engine_kind: str,
+    opts: Options,
     fp: FaultPlane,
 ) -> NodeHost:
     """One loopback NodeHost on a durable dir (h<nid> under the round
@@ -192,7 +198,9 @@ def _mk_host(
         # covers the 3 members + one churn joiner — churn and
         # observer/witness churn share the one-joiner-at-a-time rule)
         engine=EngineConfig(
-            kind=engine_kind, max_groups=32, max_peers=4, log_window=64
+            kind=opts.engine, max_groups=32, max_peers=4, log_window=64,
+            steps_per_sync=opts.steps_per_sync,
+            shard_over_mesh=opts.shard_over_mesh,
         ),
     )
     nh = NodeHost(cfg)
@@ -349,7 +357,7 @@ class _Round:
         try:
             for nid in HOSTS + (CHURN_HOST,):
                 self.hosts[nid] = _mk_host(
-                    nid, self.reg, self.dir, self.opts.engine, self.fp
+                    nid, self.reg, self.dir, self.opts, self.fp
                 )
             # warmup barrier: bring-up (incl. the cold kernel compile on
             # the vector step loop) is not part of the measured fault
@@ -457,7 +465,7 @@ class _Round:
                 self.fp.tear_wal_tails(ldir, f"tear:h{victim}")
             time.sleep(down)
             self.hosts[victim] = _mk_host(
-                victim, self.reg, self.dir, self.opts.engine, self.fp
+                victim, self.reg, self.dir, self.opts, self.fp
             )
         time.sleep(idle)
 
@@ -931,7 +939,7 @@ class _Round:
             vnh.crash()
             time.sleep(0.1)
             self.hosts[victim] = _mk_host(
-                victim, self.reg, self.dir, self.opts.engine, self.fp
+                victim, self.reg, self.dir, self.opts, self.fp
             )
         else:
             vnh.restart_cluster(CLUSTER)
@@ -945,7 +953,7 @@ class _Round:
         for nid in HOSTS:
             if self.hosts.get(nid) is None:
                 self.hosts[nid] = _mk_host(
-                    nid, self.reg, self.dir, self.opts.engine, self.fp
+                    nid, self.reg, self.dir, self.opts, self.fp
                 )
             nh = self.hosts[nid]
             nh.set_partitioned(False)
@@ -1118,12 +1126,19 @@ class _Round:
         self.result.bundle = bundle
 
     def _replay_cmd(self) -> str:
-        return (
+        cmd = (
             f"CHAOS_SEED=0x{self.seed:X} python -m "
             f"dragonboat_tpu.tools.longhaul --seed 0x{self.seed:X} "
             f"--rounds 1 --round-seconds {self.opts.round_s:g} "
             f"--engine {self.opts.engine}"
         )
+        # the engine composition is part of the repro: a sharded K-step
+        # failure must replay on the sharded K-step engine
+        if self.opts.steps_per_sync > 1:
+            cmd += f" --steps-per-sync {self.opts.steps_per_sync}"
+        if self.opts.shard_over_mesh:
+            cmd += " --shard-over-mesh"
+        return cmd
 
 
 def run_longhaul(opts: Options) -> dict:
@@ -1213,6 +1228,13 @@ def main(argv=None) -> int:
     ap.add_argument("--inject-failure", action="store_true",
                     help="force a failing verdict each round (drills the "
                          "artifact bundle + replay-command path)")
+    ap.add_argument("--steps-per-sync", type=int, default=1,
+                    help="vector engine K-step super-steps (K protocol "
+                         "steps per device sync; scalar ignores)")
+    ap.add_argument("--shard-over-mesh", action="store_true",
+                    help="shard the vector engine's lane axis over the "
+                         "local device mesh (composes with "
+                         "--steps-per-sync; scalar ignores)")
     args = ap.parse_args(argv)
     report = run_longhaul(
         Options(

@@ -163,6 +163,24 @@ def _workload(cfg: dict) -> str:
     return "through_front" if cfg.get("session_mode") else "raw"
 
 
+def _mesh(cfg: dict) -> Tuple[int, Tuple[int, ...]]:
+    """The config's device mesh: (n_devices, mesh_shape). Records that
+    predate the stamp ran unsharded single-device engines — (1, (1,))
+    by construction, so a legacy record compares cleanly against a
+    modern explicit 1-device run."""
+    try:
+        n = int(cfg.get("n_devices", 1) or 1)
+    except (TypeError, ValueError):
+        n = 1
+    shape = cfg.get("mesh_shape")
+    if isinstance(shape, (list, tuple)) and shape:
+        try:
+            return n, tuple(int(d) for d in shape)
+        except (TypeError, ValueError):
+            pass
+    return n, (n,)
+
+
 def _host_id(rec: dict) -> Optional[str]:
     """The record's box fingerprint (bench.py stamps hostname/cpu-count
     plus a timed calibration spin). None = legacy record, pre-stamp."""
@@ -250,6 +268,22 @@ def compare_config(
                 f"workload mismatch: old measured '{ow}', new measured "
                 f"'{nw}'; admitted-front throughput and raw "
                 "propose_batch throughput are different machines"
+            ],
+        }
+    # ---- honesty: a different device mesh is a different machine ------
+    # sharding the lane axis over N devices changes what one kernel
+    # launch covers and where cross-shard traffic flows; an 8-device run
+    # "beating" a 1-device run is a topology change, not a perf delta
+    # (same rule shape as the scaled-down / K / workload refusals)
+    om, nm = _mesh(old), _mesh(new)
+    if om != nm:
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"mesh mismatch: old ran {om[0]} device(s) "
+                f"(mesh {list(om[1])}), new ran {nm[0]} device(s) "
+                f"(mesh {list(nm[1])}); deltas would compare different "
+                "device topologies"
             ],
         }
     out: dict = {"verdict": PASS, "reasons": reasons}

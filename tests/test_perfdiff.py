@@ -187,6 +187,38 @@ def test_refuses_front_vs_raw_workload_mismatch():
     assert compare_config(a, legacy_front)["verdict"] == INCOMPARABLE
 
 
+def test_refuses_mesh_shape_mismatch():
+    """The mesh honesty rule (same shape as the scaled-down / K /
+    workload refusals): a run sharded over 8 devices measures a
+    different device topology than a 1-device run — the diff refuses
+    instead of reading the topology change as a win or regression.
+    Golden-fixture CLI check plus both API directions."""
+    mesh8 = os.path.join(_DATA, "perfdiff_mesh8.json")
+    for extra in ((), ("--gate",)):
+        p = _cli(BASE, mesh8, *extra)
+        assert p.returncode == 2, p.stdout + p.stderr
+        assert "INCOMPARABLE" in p.stdout
+        assert "mesh" in p.stdout
+    a = load_record(BASE)["configs"]["1"]
+    b = load_record(mesh8)["configs"]["1"]
+    r = compare_config(a, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("mesh" in s for s in r["reasons"])
+    # and in reverse (new side predates the stamp -> implicit 1 device)
+    r = compare_config(b, a)
+    assert r["verdict"] == INCOMPARABLE
+    # mesh-vs-same-mesh compares normally: the sharded trajectory gates
+    # against its own baseline without refusal
+    b2 = json.loads(json.dumps(b))
+    assert compare_config(b, b2)["verdict"] == PASS
+    # a legacy record with no stamp is a 1-device run by construction,
+    # comparable with a modern explicit 1-device stamp
+    a1 = json.loads(json.dumps(a))
+    a1["n_devices"] = 1
+    a1["mesh_shape"] = [1]
+    assert compare_config(a, a1)["verdict"] == PASS
+
+
 def test_same_steps_per_sync_stays_comparable():
     """Two runs at the SAME K>1 diff normally (the K=8 trajectory can
     gate against itself), and a missing stamp means the classic K=1
