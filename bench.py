@@ -216,6 +216,7 @@ def bench_e2e(
     log_window: int = 256,
     replicas: int = 3,
     read_ratio: int = 0,
+    read_mode: str = "readindex",
     drop_rate: float = 0.0,
     churn: bool = False,
     steps_per_sync: int = 1,
@@ -232,7 +233,12 @@ def bench_e2e(
     loopback transport.
 
     read_ratio=R submits R linearizable ReadIndex requests per write
-    (BASELINE config 3's 9:1 mix). drop_rate randomly drops that fraction
+    (BASELINE config 3's 9:1 mix). read_mode='lease' turns on
+    Config.lease_read for every group: the SAME read API, but a leader
+    holding a live quorum lease serves the read locally and an expired/
+    suspect lease degrades to the ReadIndex quorum round (config 8's
+    read_heavy A/B; the stamp makes tools.perfdiff refuse cross-mode
+    diffs). drop_rate randomly drops that fraction
     of replication traffic (config 4's log-matching divergence stress).
     churn interleaves snapshot requests and membership changes during the
     measurement (config 5). steps_per_sync=K runs the device-resident
@@ -259,8 +265,8 @@ def bench_e2e(
         return _bench_e2e_body(
             hosts, members, reg, sm_cls, groups, duration_s, payload,
             workdir, shared, wave, inbox_depth, entries_per_msg, log_window,
-            replicas, read_ratio, drop_rate, churn, steps_per_sync,
-            through_front, tenants, shard_over_mesh,
+            replicas, read_ratio, read_mode, drop_rate, churn,
+            steps_per_sync, through_front, tenants, shard_over_mesh,
         )
     finally:
         # an exception must not leak NodeHosts: the share_scope='bench'
@@ -276,8 +282,8 @@ def bench_e2e(
 def _bench_e2e_body(
     hosts, members, reg, sm_cls, groups, duration_s, payload, workdir,
     shared, wave, inbox_depth, entries_per_msg, log_window, replicas,
-    read_ratio, drop_rate, churn, steps_per_sync=1, through_front=False,
-    tenants=0, shard_over_mesh=False,
+    read_ratio, read_mode, drop_rate, churn, steps_per_sync=1,
+    through_front=False, tenants=0, shard_over_mesh=False,
 ):
     import random as _random
 
@@ -327,7 +333,7 @@ def _bench_e2e_body(
                 lambda cid, nid_: sm_cls(cid, nid_),
                 Config(
                     node_id=nid, cluster_id=c, election_rtt=300,
-                    heartbeat_rtt=30,
+                    heartbeat_rtt=30, lease_read=(read_mode == "lease"),
                 ),
             )
             for c in range(1, groups + 1)
@@ -378,6 +384,7 @@ def _bench_e2e_body(
         }
         err.update(_mesh_report(hosts, shard_over_mesh))
         err.update(_attribution_report(hosts, None, None))
+        err.update(_read_report(hosts, 0, 0.0, read_mode))
         return err
     if drop_rate > 0 and shared:
         # randomized replication drops over the co-hosted path (the wire
@@ -425,6 +432,7 @@ def _bench_e2e_body(
         out.update(_latency_report(hosts))
         out.update(_lane_report(hosts))
         out.update(_serving_report(hosts))
+        out.update(_read_report(hosts, 0, out["seconds"], read_mode))
         return out
     sessions = {
         c: hosts[leaders[c]].get_noop_session(c) for c in range(1, groups + 1)
@@ -559,7 +567,37 @@ def _bench_e2e_body(
     out.update(_latency_report(hosts))
     out.update(_lane_report(hosts))
     out.update(_serving_report(hosts))
+    out.update(_read_report(hosts, reads_done, dt, read_mode))
     return out
+
+
+def _read_report(hosts, reads_done: int, dt: float, read_mode: str) -> dict:
+    """Read-path honesty fold, ALWAYS present in every config JSON so the
+    schema is stable and tools.perfdiff can apply its read_mode refusal:
+    which read path the run measured ('readindex' quorum confirmation vs
+    'lease' local serves with automatic ReadIndex fallback), the read
+    throughput, and the engines' lease serve/fallback ledger (distinct
+    engines only — a shared core hands every host the same counters)."""
+    seen = {}
+    for nh in hosts.values():
+        eng = getattr(nh, "engine", None)
+        fn = getattr(eng, "lease_stats", None)
+        if fn is not None:
+            seen[id(getattr(eng, "core", eng))] = fn
+    local = fallback = 0
+    for fn in seen.values():
+        try:
+            d = fn()
+        except Exception:
+            continue
+        local += d["local"]
+        fallback += d["fallback"]
+    return {
+        "read_mode": read_mode,
+        "reads_per_sec": round(reads_done / dt, 1) if dt > 0 else 0.0,
+        "lease_reads_local": local,
+        "lease_reads_fallback": fallback,
+    }
 
 
 def _front_measure(
@@ -876,7 +914,15 @@ def _latency_report(hosts) -> dict:
         "apply_latency_p99_s": round(apply_.quantile(0.99), 6),
         "fsync_latency_p99_s": round(fsync.quantile(0.99), 6),
     }
+    # read-latency keys are ALWAYS present (0.0 with no read traffic):
+    # config 8's lease-vs-readindex A/B diffs them, and a stable schema
+    # is what lets perfdiff fold any two same-mode records. The histogram
+    # is serve-path agnostic — Node.read() samples at submit and records
+    # at completion whether the lease path or the quorum path served it.
     reads = merged("readindex_latency_seconds")
+    out["read_latency_p50_s"] = round(reads.quantile(0.5), 6)
+    out["read_latency_p99_s"] = round(reads.quantile(0.99), 6)
+    out["read_latency_samples"] = reads.count
     if reads.count:
         out["readindex_latency_p99_s"] = round(reads.quantile(0.99), 6)
     return out
@@ -1036,6 +1082,19 @@ LADDER = {
         nominal_groups=64, groups=64, replicas=3, payload=16,
         wave=32, duration=8.0, through_front=True, tenants=4,
     ),
+    # read_heavy: config 2's fleet shape under a 9:1 read:write mix, run
+    # TWICE — once with reads on the ReadIndex quorum path, once with
+    # leader leases serving reads locally (automatic ReadIndex fallback
+    # on expiry/suspect). The record is the LEASE run (stamped
+    # read_mode='lease' so perfdiff refuses cross-mode diffs) carrying
+    # the ReadIndex run's read numbers under `readindex_mode` plus the
+    # reads/s speedup ratio — the lease read path's headline.
+    8: dict(
+        label="3-node, 1024 groups, 16B, read_heavy 9:1, "
+              "lease vs ReadIndex reads",
+        nominal_groups=1024, groups=1024, replicas=3, payload=16,
+        wave=8, duration=10.0, read_ratio=9, both_read_modes=True,
+    ),
 }
 
 
@@ -1054,23 +1113,48 @@ def _run_ladder_config(
             # lands inside the watchdog budget on the fallback box
             groups = min(groups, 256)
             duration = min(duration, 6.0)
-    workdir = tempfile.mkdtemp(prefix=f"dbtpu-bench-c{n}-")
-    try:
-        r = bench_e2e(
-            groups, duration, spec["payload"], workdir,
-            wave=spec["wave"],
-            entries_per_msg=spec.get("entries_per_msg", 64),
-            replicas=spec["replicas"],
-            read_ratio=spec.get("read_ratio", 0),
-            drop_rate=spec.get("drop_rate", 0.0),
-            churn=spec.get("churn", False),
-            steps_per_sync=spec.get("steps_per_sync", 1),
-            through_front=spec.get("through_front", False),
-            tenants=spec.get("tenants", 0),
-            shard_over_mesh=spec.get("shard_over_mesh", False),
-        )
-    finally:
-        shutil.rmtree(workdir, ignore_errors=True)
+    def _run(read_mode: str) -> dict:
+        workdir = tempfile.mkdtemp(prefix=f"dbtpu-bench-c{n}-")
+        try:
+            return bench_e2e(
+                groups, duration, spec["payload"], workdir,
+                wave=spec["wave"],
+                entries_per_msg=spec.get("entries_per_msg", 64),
+                replicas=spec["replicas"],
+                read_ratio=spec.get("read_ratio", 0),
+                read_mode=read_mode,
+                drop_rate=spec.get("drop_rate", 0.0),
+                churn=spec.get("churn", False),
+                steps_per_sync=spec.get("steps_per_sync", 1),
+                through_front=spec.get("through_front", False),
+                tenants=spec.get("tenants", 0),
+                shard_over_mesh=spec.get("shard_over_mesh", False),
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    if spec.get("both_read_modes"):
+        # the read_heavy A/B: ReadIndex-mode first (the baseline), then
+        # the lease-mode run that IS the config record. Both halves ran
+        # on the same box minutes apart, so the speedup ratio inside one
+        # record is the honest same-host comparison perfdiff's
+        # read_mode refusal would otherwise forbid across records.
+        base = _run("readindex")
+        r = _run("lease")
+        r["readindex_mode"] = {
+            k: base[k]
+            for k in (
+                "value", "reads_per_sec", "read_latency_p50_s",
+                "read_latency_p99_s", "read_latency_samples", "committed",
+                "seconds", "bring_up_s",
+            )
+            if k in base
+        }
+        rps, base_rps = r.get("reads_per_sec", 0), base.get("reads_per_sec")
+        if base_rps:
+            r["lease_vs_readindex_reads"] = round(rps / base_rps, 3)
+    else:
+        r = _run(spec.get("read_mode", "readindex"))
     r["label"] = spec["label"]
     # bench honesty: the JSON names BOTH the regime the ladder config
     # claims (nominal_groups) and what this run actually exercised
@@ -1087,8 +1171,8 @@ def _run_ladder_config(
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    choices=[0, 1, 2, 3, 4, 5, 6, 7],
-                    help="run ONE BASELINE.json ladder config (1-7) at its "
+                    choices=[0, 1, 2, 3, 4, 5, 6, 7, 8],
+                    help="run ONE BASELINE.json ladder config (1-8) at its "
                          "declared scale instead of the full reduced sweep")
     ap.add_argument("--groups", type=int, default=0,
                     help="override group count (with --config)")

@@ -40,6 +40,7 @@ SERVING_FRONT = "serving/front.py"
 SERVING_SESSIONS = "serving/sessions.py"
 SERVING_PLACEMENT = "serving/placement.py"
 CHUNKS = "transport/chunks.py"
+FAULTS = "faults.py"
 
 FnKey = Tuple[str, str]  # (relpath, qualname)
 
@@ -309,6 +310,13 @@ def _default_targets() -> Targets:
             "device-sync site-attribution table (leaf)",
         ),
         LockSpec(
+            "ClockPlane", "_mu", 60,
+            "per-host clock-fault table (ISSUE 17: skew/drift/jump "
+            "anchors); leaf — clock_fn closures read it from every tick "
+            "worker with no other lock held, mutations come from the "
+            "chaos scheduler thread",
+        ),
+        LockSpec(
             "CompileWatch", "_mu", 60,
             "compile-event counters + registered-function table (leaf)",
         ),
@@ -368,7 +376,23 @@ def _default_targets() -> Targets:
                 "_free": "_lanes_mu",
                 "_lane_by_g": "_lanes_mu",
                 "_route": "_lanes_mu",
+                # the clock-fault plane (ISSUE 17): per-host suspect
+                # deadlines are written by tick-worker threads reporting
+                # anomalies and drained by the loop thread — a write
+                # outside _dirty_mu is a lost-revocation (stale lease
+                # read) class of bug. The lease mirrors themselves
+                # (_m_lease_ok, _lease_local, _lease_fb) are loop-thread
+                # only, like every other _m_* mirror.
+                "_clock_suspect": "_dirty_mu",
             },
+        },
+        # the clock-fault plane (ISSUE 17): each host's [anchor_real,
+        # anchor_fault, rate] triple is read by that host's tick worker
+        # on every tick and rewritten by the chaos scheduler — a write
+        # outside _mu tears the re-anchor continuity rule and turns a
+        # drift change into a spurious step jump
+        FAULTS: {
+            "ClockPlane": {"_hosts": "_mu"},
         },
         NODEHOST: {
             "NodeHost": {
@@ -492,6 +516,7 @@ __all__ = [
     "LockSpec",
     "Targets",
     "CHUNKS",
+    "FAULTS",
     "KERNEL",
     "KV",
     "LOGDB",

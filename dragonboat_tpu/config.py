@@ -42,6 +42,27 @@ class Config:
     # replica from bumping a stable quorum's term. Off by default: the
     # False path is bit-identical to the pre-knob protocol.
     pre_vote: bool = False
+    # Leader leases: a leader that heard heartbeat acks from a quorum
+    # within one heartbeat round serves linearizable reads LOCALLY (no
+    # ReadIndex quorum round-trip) until the lease expires. The lease is
+    # bounded strictly below the minimum randomized election timeout
+    # minus the skew margin (lease_margin_rtt), so no rival can win an
+    # election while a live lease could still serve reads — provided
+    # host clocks drift less than the margin per election window; the
+    # ClockPlane chaos apparatus (faults.py) attacks exactly that
+    # assumption and the watchdog-detected clock-anomaly path revokes
+    # the lease rather than trusting it. Off by default: the False path
+    # is bit-identical to the pre-knob protocol, and an expired/revoked
+    # lease always falls back to the ReadIndex path (degradation, not
+    # danger).
+    lease_read: bool = False
+    # Skew margin in RTT ticks subtracted from the lease lifetime:
+    # lease duration = election_rtt - lease_margin_rtt, granted from
+    # the quorum round's START tick. 0 = auto (one heartbeat_rtt).
+    # Must leave a positive lease: lease_margin_rtt < election_rtt -
+    # heartbeat_rtt (the grant lags the round start by up to one
+    # heartbeat round-trip).
+    lease_margin_rtt: int = 0
 
     def validate(self) -> None:
         # cf. config/config.go:176-208 Validate
@@ -61,6 +82,24 @@ class Config:
             raise ConfigError("witness node can not take snapshot")
         if self.is_witness and self.is_observer:
             raise ConfigError("witness node can not be an observer")
+        if self.lease_margin_rtt < 0:
+            raise ConfigError("LeaseMarginRTT must be >= 0")
+        if self.lease_read:
+            if self.is_witness or self.is_observer:
+                raise ConfigError(
+                    "witness/observer node can not serve lease reads"
+                )
+            margin = self.lease_margin_rtt or self.heartbeat_rtt
+            if margin >= self.election_rtt - self.heartbeat_rtt:
+                raise ConfigError(
+                    "invalid lease margin, LeaseMarginRTT must be < "
+                    "ElectionRTT - HeartbeatRTT or the lease never opens"
+                )
+
+    def lease_margin_ticks(self) -> int:
+        """The effective skew margin (ticks) a lease grant subtracts:
+        the configured LeaseMarginRTT, or one heartbeat RTT when auto."""
+        return self.lease_margin_rtt or self.heartbeat_rtt
 
     def get_max_in_mem_log_size(self) -> int:
         if self.max_in_mem_log_size == 0:

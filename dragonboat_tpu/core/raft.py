@@ -86,6 +86,20 @@ class Raft:
         self.quiesced = False
         self.check_quorum = cfg.check_quorum
         self.pre_vote = cfg.pre_vote
+        # Leader lease (Config.lease_read): a quorum of heartbeat acks
+        # tagged with the current lease round's start tick grants a lease
+        # of (election_rtt - margin) ticks FROM THE ROUND START — strictly
+        # below the minimum randomized election timeout, so no rival can
+        # be elected while a live lease serves local reads, as long as
+        # clocks drift less than the margin per election window.
+        self.lease_read = cfg.lease_read
+        self.lease_margin = cfg.lease_margin_ticks() if cfg.lease_read else 0
+        self.lease_until = 0  # tick_count bound (exclusive)
+        self.lease_round_tick = 0  # current heartbeat round's start tick
+        self.lease_acks: set = set()  # voting peers that acked this round
+        self.clock_suspect_until = 0  # no grants/serves before this tick
+        self.lease_served = 0  # reads served locally off the lease
+        self.lease_fallback = 0  # lease-mode reads that fell back to quorum
         self.tick_count = 0
         self.election_tick = 0
         self.heartbeat_tick = 0
@@ -350,30 +364,48 @@ class Raft:
             if nid != self.node_id:
                 self.send_replicate_message(nid)
 
-    def send_heartbeat_message(self, to: int, hint: SystemCtx, match: int) -> None:
+    def send_heartbeat_message(
+        self, to: int, hint: SystemCtx, match: int, lease_tick: int = 0
+    ) -> None:
         self._send(
             Message(
                 to=to,
                 type=MT.HEARTBEAT,
+                # the lease round tag rides the otherwise-unused heartbeat
+                # log_index field (0 when leases are off — bit-identical);
+                # followers echo it back verbatim in HEARTBEAT_RESP
+                log_index=lease_tick,
                 commit=min(match, self.log.committed),
                 hint=hint.low,
                 hint_high=hint.high,
             )
         )
 
-    def broadcast_heartbeat_message(self, ctx: Optional[SystemCtx] = None) -> None:
+    def broadcast_heartbeat_message(
+        self, ctx: Optional[SystemCtx] = None, new_lease_round: bool = False
+    ) -> None:
         self._must_be_leader()
         if ctx is None:
             if self.read_index.has_pending_request():
                 ctx = self.read_index.peep_ctx()
             else:
                 ctx = SystemCtx()
+        tag = 0
+        if self.lease_read:
+            if new_lease_round:
+                # a fresh quorum round opens ONLY on the periodic
+                # heartbeat (hb_due): ctx-carrying ReadIndex broadcasts
+                # stamp the CURRENT round without resetting its acks, or
+                # read traffic would starve the lease of full rounds
+                self.lease_round_tick = self.tick_count
+                self.lease_acks = set()
+            tag = self.lease_round_tick
         for nid, rm in self.voting_members().items():
             if nid != self.node_id:
-                self.send_heartbeat_message(nid, ctx, rm.match)
+                self.send_heartbeat_message(nid, ctx, rm.match, tag)
         if ctx.is_zero():
             for nid, rm in self.observers.items():
-                self.send_heartbeat_message(nid, ctx, rm.match)
+                self.send_heartbeat_message(nid, ctx, rm.match, tag)
 
     def send_timeout_now_message(self, node_id: int) -> None:
         self._send(Message(type=MT.TIMEOUT_NOW, to=node_id))
@@ -411,6 +443,11 @@ class Raft:
         self.read_index = ReadIndexTracker()
         self.pending_config_change = False
         self.abort_leader_transfer()
+        # any role transition revokes the lease outright — new leadership
+        # must re-earn it via a fresh quorum heartbeat round
+        self.lease_until = 0
+        self.lease_round_tick = 0
+        self.lease_acks = set()
         self._reset_remotes()
 
     def _reset_remotes(self) -> None:
@@ -829,7 +866,7 @@ class Raft:
 
     # ------------------------------------------------------- leader handlers
     def _handle_leader_heartbeat(self, m: Message) -> None:
-        self.broadcast_heartbeat_message()
+        self.broadcast_heartbeat_message(new_lease_round=True)
 
     def _handle_leader_check_quorum(self, m: Message) -> None:
         self._must_be_leader()
@@ -863,6 +900,28 @@ class Raft:
     def _add_ready_to_read(self, index: int, ctx: SystemCtx) -> None:
         self.ready_to_read.append(ReadyToRead(index=index, system_ctx=ctx))
 
+    def lease_valid(self) -> bool:
+        """Whether a live leader lease can serve a linearizable read
+        locally RIGHT NOW. Expiry, step-down (any _reset), an in-flight
+        leadership transfer and a host-reported clock anomaly all answer
+        False — the read then rides the ReadIndex quorum path instead
+        (degradation, not danger)."""
+        return (
+            self.lease_read
+            and self.is_leader()
+            and not self.leader_transfering()
+            and self.tick_count >= self.clock_suspect_until
+            and self.tick_count < self.lease_until
+        )
+
+    def set_clock_suspect(self, hold_ticks: int) -> None:
+        """Host-side clock-anomaly report (the tick worker's backlog /
+        backward-jump detector): revoke any live lease and refuse
+        re-grants for hold_ticks, forcing reads onto the ReadIndex path
+        until the tick plane has proven sane again."""
+        self.clock_suspect_until = self.tick_count + max(int(hold_ticks), 0)
+        self.lease_until = 0
+
     def _handle_leader_read_index(self, m: Message) -> None:
         self._must_be_leader()
         ctx = SystemCtx(low=m.hint, high=m.hint_high)
@@ -872,6 +931,25 @@ class Raft:
                 # entry at its current term first
                 self._report_dropped_read_index(m)
                 return
+            if self.lease_valid():
+                # lease fast path: the quorum promised not to elect anyone
+                # else before lease_until, so the local committed index IS
+                # the linearization point — no heartbeat round needed
+                self.lease_served += 1
+                self._add_ready_to_read(self.log.committed, ctx)
+                if m.from_ not in (NO_NODE, self.node_id):
+                    self._send(
+                        Message(
+                            to=m.from_,
+                            type=MT.READ_INDEX_RESP,
+                            log_index=self.log.committed,
+                            hint=m.hint,
+                            hint_high=m.hint_high,
+                        )
+                    )
+                return
+            if self.lease_read:
+                self.lease_fallback += 1
             self.read_index.add_request(self.log.committed, ctx, m.from_)
             self.broadcast_heartbeat_message(ctx)
         else:
@@ -918,6 +996,26 @@ class Raft:
         self._must_be_leader()
         rp.set_active()
         rp.wait_to_retry()
+        if (
+            self.lease_read
+            and m.log_index != 0
+            and m.log_index == self.lease_round_tick
+            and m.from_ in self.voting_members()
+        ):
+            # an echo of the CURRENT round's tag from a voting peer;
+            # stale-round echoes (tag < current) are ignored — renewals
+            # only ever count one coherent quorum round, conservatively
+            self.lease_acks.add(m.from_)
+            if (
+                len(self.lease_acks) + 1 >= self.quorum()
+                and self.tick_count >= self.clock_suspect_until
+            ):
+                self.lease_until = max(
+                    self.lease_until,
+                    self.lease_round_tick
+                    + self.election_timeout
+                    - self.lease_margin,
+                )
         if rp.match < self.log.last_index():
             self.send_replicate_message(m.from_)
         if m.hint != 0:
@@ -1115,6 +1213,8 @@ class Raft:
             Message(
                 to=m.from_,
                 type=MT.HEARTBEAT_RESP,
+                # echo the leader's lease round tag (0 when leases off)
+                log_index=m.log_index,
                 hint=m.hint,
                 hint_high=m.hint_high,
             )

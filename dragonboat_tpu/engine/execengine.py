@@ -120,6 +120,7 @@ class ExecEngine:
             ),
         )
         self._wd_wait = min(0.5, max(tick_period_s, 1e-3))
+        self._tick_period_s = max(tick_period_s, 1e-3)
         # Python threads contend on the GIL: default pools are smaller than
         # the Go engine's 16; protocol work is lock-striped the same way
         self._n_step = num_step_workers or min(hard.step_engine_worker_count, 8)
@@ -330,6 +331,52 @@ class ExecEngine:
     def fairness_stats(self) -> dict:
         """Tick-fairness watchdog snapshot (see engine/fairness.py)."""
         return self.watchdog.stats()
+
+    def lease_stats(self) -> dict:
+        """Lease read counters, shape-compatible with
+        VectorEngine.lease_stats(): 'local' / 'fallback' summed from each
+        group's scalar core (plain int reads — a torn read costs one
+        stale sample on an export path, never a protocol decision)."""
+        local = fb = 0
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            r = getattr(node.peer, "raft", None)
+            if r is not None:
+                local += r.lease_served
+                fb += r.lease_fallback
+        return {"local": local, "fallback": fb}
+
+    def lease_valid(self, cluster_id: int) -> bool:
+        """Does this group's scalar core hold a live leader lease right
+        now? Probe read for NodeHost.lease_read; the authoritative
+        serve/fallback decision stays in the core's read path."""
+        with self._nodes_mu:
+            node = self._nodes.get(cluster_id)
+        if node is None or node.stopped:
+            return False
+        r = getattr(node.peer, "raft", None)
+        if r is None:
+            return False
+        with node._mu:
+            return bool(r.lease_valid())
+
+    def set_clock_suspect(self, hold_s: float) -> None:
+        """Clock-anomaly report from the host's tick worker: revoke every
+        group's lease and refuse re-grants for hold_s (converted to ticks
+        at the engine tick period) — lease reads degrade to the ReadIndex
+        quorum path until the tick plane has proven sane again."""
+        ticks = max(1, int(hold_s / self._tick_period_s + 0.999))
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            if node.stopped:
+                continue
+            try:
+                with node._mu:
+                    node.peer.raft.set_clock_suspect(ticks)
+            except Exception:
+                continue  # racing a concurrent close
 
     def pressure_stats(self) -> dict:
         """Serving-front backpressure probe, shape-compatible with

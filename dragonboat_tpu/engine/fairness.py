@@ -96,6 +96,7 @@ class FairnessWatchdog:
         self._yields = 0
         self._tick_burst_max = 0
         self._tick_bursts_clamped = 0
+        self._clock_anomalies = 0
         self._closed = False
         _register(self)
 
@@ -155,6 +156,17 @@ class FairnessWatchdog:
         self._tick_bursts_clamped += 1
         flight_recorder().record("tick_burst_clamped", loop=self.name)
 
+    def note_clock_anomaly(self) -> None:
+        """The tick plane detected a clock anomaly (backward reading or a
+        step-jump, see NodeHost._tick_worker_main): discard the current
+        gap window and re-anchor the beat. The phantom gap a jumped
+        clock mints is a CLOCK fault, not a scheduling stall — without
+        the discard it would sit in the 256-iteration window and fail
+        chaos runs' fairness_no_stall verdict for the wrong reason."""
+        self._clock_anomalies += 1
+        flight_recorder().record("clock_anomaly", loop=self.name)
+        self.reset_window()
+
     def reset_window(self) -> None:
         """Forget the windowed maximum (NOT the lifetime max_gap_s).
         Chaos harnesses call this after bring-up so the cold-compile
@@ -194,6 +206,7 @@ class FairnessWatchdog:
             "starvation_ratio": self._recent_max_s / self.tick_period_s,
             "tick_burst_max": self._tick_burst_max,
             "tick_bursts_clamped": self._tick_bursts_clamped,
+            "clock_anomalies": self._clock_anomalies,
             "fairness_yields": self._yields,
             "iterations": self._iters,
             "protocol_steps": self._steps,

@@ -222,6 +222,12 @@ def _make_activate_fn(cfg: KernelConfig, n: int):
             rand_timeout=s.rand_timeout.at[gi].set(v["rand_timeout"]),
             check_quorum=s.check_quorum.at[gi].set(v["check_quorum"]),
             prevote_on=s.prevote_on.at[gi].set(v["prevote_on"]),
+            lease_on=s.lease_on.at[gi].set(v["lease_on"]),
+            lease_margin=s.lease_margin.at[gi].set(v["lease_margin"]),
+            # a reused lane must not inherit its predecessor's lease
+            lease_until=s.lease_until.at[gi].set(zi),
+            hb_round_tick=s.hb_round_tick.at[gi].set(zi),
+            hb_ack_bits=s.hb_ack_bits.at[gi].set(zi),
             first_index=s.first_index.at[gi].set(v["first_index"]),
             marker_term=s.marker_term.at[gi].set(v["marker_term"]),
             last_index=s.last_index.at[gi].set(v["last_index"]),
@@ -799,7 +805,7 @@ def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
             )
     gs, ps = np.nonzero(send_flags & SEND_HEARTBEAT)
     if gs.size:
-        for g, p, b, term, hb_commit, hint, hint2 in zip(
+        for g, p, b, term, hb_commit, hint, hint2, lease_round in zip(
             gs.tolist(),
             ps.tolist(),
             base[gs].tolist(),
@@ -807,6 +813,7 @@ def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
             o["send_hb_commit"][gs, ps].tolist(),
             o["send_hint"][gs, ps].tolist(),
             o["send_hint2"][gs, ps].tolist(),
+            o["lease_round"][gs].tolist(),
         ):
             tgt = _send_target(lane_by_g, g, p)
             if tgt is None:
@@ -821,6 +828,9 @@ def gather_post_sends(o: dict, base, lane_by_g) -> List[Tuple[_Lane, Message]]:
                         to=to_nid,
                         from_=lane.node.node_id(),
                         term=term,
+                        # lease round tag: an opaque tick stamp the follower
+                        # echoes back, NOT an index — no +b translation
+                        log_index=lease_round,
                         commit=b + hb_commit,
                         hint=hint,
                         hint_high=hint2,
@@ -1034,9 +1044,14 @@ class VectorEngine:
             self._mesh_devices = n
 
             def _shard_for(x, _mesh=mesh, _NS=NamedSharding, _P=PartitionSpec):
-                return _NS(
-                    _mesh, _P(*(("groups",) + (None,) * (x.ndim - 1)))
-                )
+                # canonical spec: trailing dims replicate implicitly. An
+                # explicit trailing None is the SAME placement but a
+                # DIFFERENT jit cache key than the normalized spec jit
+                # outputs carry, so a fresh device_put state would re-trace
+                # every activation bucket once — and whether that second
+                # trace lands before or after a compile-audit mark depends
+                # on how lane-add batches happen to coalesce
+                return _NS(_mesh, _P("groups"))
 
             self._sharding = _shard_for
         self._groups_requested = groups_requested
@@ -1201,6 +1216,16 @@ class VectorEngine:
         self._host_refs: Set[int] = set()
         self._next_host = 0
         self._blocked_hosts: Set[int] = set()  # partitioned NodeHosts
+        # per-host clock-suspect deadlines (monotonic seconds): a host
+        # whose tick worker reported a clock anomaly loses lease rights
+        # (clock_ok=False) on all its lanes until the hold expires.
+        # Written by tick workers under _dirty_mu, reconciled onto the
+        # device clock_ok plane by the loop thread on transitions only.
+        self._clock_suspect: Dict[int, float] = {}
+        # cumulative lease read counters (loop-thread writes, lock-free
+        # int reads via lease_stats)
+        self._lease_local = 0
+        self._lease_fb = 0
         # chaos hook over co-hosted delivery (the analogue of the
         # transport's pre-send hook for traffic that never touches the
         # wire): return True to drop the message
@@ -1326,6 +1351,12 @@ class VectorEngine:
         self._m_snap_pending = np.zeros(G, bool)
         self._m_quiesced = np.zeros(G, bool)
         self._m_host = np.zeros(G, np.int32)  # owning handle id per lane
+        self._m_clock_ok = np.ones(G, bool)  # mirror of device clock_ok
+        # lease validity after the last decoded step (StepOutput.lease_ok):
+        # read by the lease-only probe (NodeHost.lease_read) with zero
+        # device syncs; a stale read is inherent to probing and safe — the
+        # serve itself is decided by the kernel, not this mirror
+        self._m_lease_ok = np.zeros(G, bool)
         # engine-clock tick of the lane's last LEADER transition: feeds the
         # per-lane ticks_since_leader_change gauge (lane_stats) with zero
         # device syncs — updated only for lanes the decode phase already
@@ -1383,6 +1414,14 @@ class VectorEngine:
         with self._lanes_mu:
             lane = self._lanes.get(key)
         return lane.node if lane is not None else None
+
+    def lease_valid(self, key) -> bool:
+        """Did this lane hold a live leader lease after the last decoded
+        step? Mirror read (no device sync) for the lease-only probe;
+        the authoritative serve/fallback decision stays in the kernel."""
+        with self._lanes_mu:
+            lane = self._lanes.get(key)
+        return lane is not None and bool(self._m_lease_ok[lane.g])
 
     # -------------------------------------------------------------- wakeups
     def set_node_ready(self, key) -> None:
@@ -1498,6 +1537,45 @@ class VectorEngine:
         # path, where the partition drop applies)
         self._routes_dirty = True
 
+    def set_clock_suspect(self, host: int, hold_s: float) -> None:
+        """Clock-anomaly report from a host's tick worker (backward
+        reading / backlog past the catch-up cap): every lane owned by
+        `host` loses lease rights (clock_ok=False) until the hold
+        expires — lease reads degrade to the ReadIndex quorum path,
+        never to staleness. Cheap to call; the loop thread touches the
+        device only on suspect-set transitions."""
+        deadline = time.monotonic() + max(float(hold_s), 0.0)
+        with self._dirty_mu:
+            cur = self._clock_suspect.get(host, 0.0)
+            self._clock_suspect[host] = max(cur, deadline)
+        self._ready.set()
+
+    def _apply_clock_suspect(self) -> None:
+        """Loop-thread reconcile of the per-host suspect deadlines onto
+        the per-lane clock_ok plane. No-op (one dict probe) while no host
+        is suspect; while one is, a G-bool compare per iteration and a
+        device write only when the lane set actually changes — including
+        the final restore when the last hold expires."""
+        if not self._clock_suspect and self._m_clock_ok.all():
+            return
+        now = time.monotonic()
+        with self._dirty_mu:
+            for h in [
+                h for h, d in self._clock_suspect.items() if d <= now
+            ]:
+                del self._clock_suspect[h]
+            bad = list(self._clock_suspect)
+        if bad:
+            want = ~np.isin(self._m_host, np.asarray(bad, np.int32))
+        else:
+            want = np.ones(self.kcfg.groups, bool)
+        if not np.array_equal(want, self._m_clock_ok):
+            self._m_clock_ok = want
+            arr = jnp.asarray(want)
+            if self._sharding is not None:
+                arr = jax.device_put(arr, self._sharding(arr))
+            self._state = self._state._replace(clock_ok=arr)
+
     def set_local_drop_hook(self, hook) -> None:
         """Install a chaos drop predicate over co-hosted delivery
         (hook(message) -> True drops it). None clears. While a hook is
@@ -1574,6 +1652,7 @@ class VectorEngine:
                 self._rebase_due = False
                 self._do_rebase()
         self._apply_reconciles()
+        self._apply_clock_suspect()
         with self._snap_status_mu:
             snap_done, self._snap_status = self._snap_status, set()
         for node in snap_done:
@@ -2147,6 +2226,9 @@ class VectorEngine:
         if t == MT.HEARTBEAT:
             self._stage_row(
                 g, k, MSG.HEARTBEAT, from_slot=from_slot, term=m.term,
+                # log_index is the lease round tag (opaque tick stamp,
+                # 0 when leases off) — staged raw, no -b translation
+                log_index=m.log_index,
                 commit=max(m.commit - b, 0), hint=m.hint,
                 hint_high=m.hint_high,
             )
@@ -2193,6 +2275,8 @@ class VectorEngine:
         if t == MT.HEARTBEAT_RESP:
             self._stage_row(
                 g, k, MSG.HEARTBEAT_RESP, from_slot=from_slot, term=m.term,
+                # echoed lease round tag, raw (see MT.HEARTBEAT above)
+                log_index=m.log_index,
                 hint=m.hint, hint_high=m.hint_high,
             )
             return True
@@ -2526,6 +2610,12 @@ class VectorEngine:
         the per-step stats base count."""
         lane_by_g = self._lane_by_g
         base = self._m_base
+        # lease read counters: per-step deltas from the kernel, folded
+        # into the engine totals (numpy sums over planes the decode
+        # already fetched — zero extra device syncs)
+        self._lease_local += int(o["lease_served"].sum())
+        self._lease_fb += int(o["lease_fallback"].sum())
+        self._m_lease_ok = np.asarray(o["lease_ok"])
         # ---- phase 0: place payloads at device-assigned indexes ----------
         # columnar: ONE gather per StepOutput plane over every packed row,
         # then plain-python iteration (no per-element device_get reads)
@@ -3496,6 +3586,8 @@ class VectorEngine:
             rand_timeout=rand_to,
             check_quorum=cfg.check_quorum,
             prevote_on=bool(cfg.pre_vote),
+            lease_on=bool(cfg.lease_read),
+            lease_margin=cfg.lease_margin_ticks() if cfg.lease_read else 0,
             first_index=dev_first,
             marker_term=marker_term,
             last_index=dev_last,
@@ -3521,6 +3613,8 @@ class VectorEngine:
         ("rand_timeout", np.int32),
         ("check_quorum", bool),
         ("prevote_on", bool),
+        ("lease_on", bool),
+        ("lease_margin", np.int32),
         ("first_index", np.int32),
         ("marker_term", np.int32),
         ("last_index", np.int32),
@@ -3953,6 +4047,14 @@ class VectorEngine:
         StepOutput, so reading them costs nothing on the device."""
         return dict(self._sstats)
 
+    def lease_stats(self) -> dict:
+        """Cumulative lease read counters across all lanes: 'local' =
+        linearizable reads served straight off a live leader lease (no
+        quorum round), 'fallback' = lease-enabled reads that degraded to
+        the ReadIndex quorum path (lease expired / revoked / clock
+        suspect). Plain int reads of decode-maintained counters."""
+        return {"local": self._lease_local, "fallback": self._lease_fb}
+
     def pressure_stats(self) -> dict:
         """Serving-front backpressure probe (serving.backpressure.
         SaturationMonitor): inbox-row occupancy of the last packed step
@@ -4138,6 +4240,14 @@ class VectorEngineHandle:
 
     def set_host_partitioned(self, partitioned: bool) -> None:
         self.core.set_host_partitioned(self.host, partitioned)
+
+    def set_clock_suspect(self, hold_s: float) -> None:
+        """Clock-anomaly report scoped to THIS host's lanes (a shared
+        core serves several NodeHosts, each with its own tick worker)."""
+        self.core.set_clock_suspect(self.host, hold_s)
+
+    def lease_valid(self, cluster_id: int) -> bool:
+        return self.core.lease_valid((self.host, cluster_id))
 
     def leader_snapshot(self) -> Dict[int, Tuple[int, int]]:
         """cluster_id -> (leader_node_id, term) for this host's lanes."""

@@ -60,6 +60,7 @@ class FaultSpec:
     fsync_stall: float = 0.0  # P(sync stalls)
     fsync_stall_s: Tuple[float, float] = (0.002, 0.02)
     fsync_error: float = 0.0  # P(sync raises IOError)
+    append_error: float = 0.0  # P(one WAL record append raises IOError)
     # P(a crash_restart window also tears the victim's WAL tail before
     # the restart) — the mid-write power-cut on top of the process death
     tear_tail: float = 0.0
@@ -450,6 +451,19 @@ class FaultPlane:
 
         return make
 
+    def maybe_append_fault(self, site: str) -> None:
+        """Injection point FaultyKV arms on the store's per-record append
+        seam (`WalKV.set_append_fault`): raises mid-record-group, BEFORE
+        the commit seal, so the store's rollback path — not recovery
+        luck — must guarantee no half-sealed group survives a reopen."""
+        spec = self.spec
+        if spec.append_error and self.decide(
+            site, "append_error", spec.append_error
+        ):
+            raise IOError(
+                f"FaultPlane(seed={self.seed}): injected append error"
+            )
+
     def maybe_fsync_fault(self, site: str) -> None:
         """The injection point FaultyKV runs before a durability barrier."""
         spec = self.spec
@@ -482,6 +496,13 @@ class FaultyKV(IKVStore):
         self.plane = plane
         self.site = site
         self._fsync_observer = None
+        # arm the per-record append seam when the store exposes one
+        # (WalKV): the fault fires INSIDE a record group, before the
+        # commit seal, which is the torn-batch case fsync faults can't
+        # reach
+        set_af = getattr(inner, "set_append_fault", None)
+        if set_af is not None:
+            set_af(lambda: plane.maybe_append_fault(site))
 
     def name(self) -> str:
         return f"faulty-{self.inner.name()}"
@@ -539,6 +560,154 @@ class FaultyKV(IKVStore):
         self.inner.full_compaction()
 
 
+class ClockPlane:
+    """Seeded clock-fault injection for the tick plane.
+
+    Raft here has no wall clock: every timeout is counted in ticks, and
+    ticks are minted by each NodeHost's tick worker off a monotonic
+    clock. A machine whose clock skews, drifts or step-jumps therefore
+    shows up as a tick stream that runs fast, slow, or lurches — exactly
+    the failure a leader lease must survive. ClockPlane models that by
+    owning an injectable per-host clock (`clock_fn(host)`) that NodeHost
+    substitutes for `time.monotonic` in its tick worker.
+
+    Per host the faulted clock is a piecewise-linear transform of real
+    monotonic time: ``fault(t) = f0 + (t - r0) * rate``. Mutations
+    re-anchor (r0, f0) at the current faulted reading first, so:
+
+      * `set_skew` / `step_jump` — add an instant offset (negative jumps
+        make the clock read BACKWARD, the anomaly the tick worker must
+        detect rather than replay as a tick burst);
+      * `set_drift` — change the rate (0.5 = half speed, 2.0 = double);
+      * `clear` — pin rate back to 1.0 while keeping the accumulated
+        offset (continuity: healing a drift must not itself be a jump).
+
+    The transform is draw-free, so the *clock* needs no replay contract;
+    the seeded part is `chaos_schedule`, whose decisions ride the owning
+    FaultPlane's streams and land in its schedule log — the same
+    bit-identical `schedule_signature()` replay contract as
+    `crash_restart_schedule`."""
+
+    def __init__(self, plane: FaultPlane) -> None:
+        self.plane = plane
+        self._mu = threading.Lock()
+        # host -> [anchor_real r0, anchor_fault f0, rate]
+        self._hosts: Dict[str, list] = {}
+
+    # ------------------------------------------------------------ reading
+    def now(self, host) -> float:
+        real = time.monotonic()
+        with self._mu:
+            st = self._hosts.get(host)
+            if st is None:
+                return real
+            r0, f0, rate = st
+        return f0 + (real - r0) * rate
+
+    def clock_fn(self, host) -> Callable[[], float]:
+        """The injectable clock a NodeHost mounts in its tick worker
+        (`NodeHost.set_tick_clock`). Hosts without injected faults read
+        real monotonic time, so mounting the plane everywhere is free."""
+        return lambda: self.now(host)
+
+    # ---------------------------------------------------------- mutations
+    def _reanchor_locked(self, host) -> list:
+        """Pin (r0, f0) at the current faulted reading so the mutation
+        about to follow is continuous. Caller holds self._mu."""
+        real = time.monotonic()
+        st = self._hosts.get(host)
+        if st is None:
+            st = [real, real, 1.0]
+            self._hosts[host] = st
+        else:
+            r0, f0, rate = st
+            st[0] = real
+            st[1] = f0 + (real - r0) * rate
+        return st
+
+    def set_skew(self, host, offset_s: float) -> None:
+        """Step the host's clock by offset_s (instant, signed)."""
+        with self._mu:
+            self._reanchor_locked(host)[1] += float(offset_s)
+
+    def step_jump(self, host, offset_s: float) -> None:
+        """A large instant step — same mechanics as `set_skew`, named
+        separately so fault schedules and flight-recorder timelines can
+        distinguish sub-tick skew from multi-tick lurches."""
+        self.set_skew(host, offset_s)
+
+    def set_drift(self, host, rate: float) -> None:
+        """Run the host's clock at `rate` × real time from now on."""
+        with self._mu:
+            self._reanchor_locked(host)[2] = max(float(rate), 0.0)
+
+    def clear(self, host) -> None:
+        """Heal drift (rate back to 1.0) keeping the accumulated offset;
+        clearing must not itself inject a jump."""
+        with self._mu:
+            self._reanchor_locked(host)[2] = 1.0
+
+    def reset(self, host) -> None:
+        """Drop all fault state: the host reads real time again. This IS
+        a (possibly backward) jump — use `clear` for a continuous heal."""
+        with self._mu:
+            self._hosts.pop(host, None)
+
+    # ----------------------------------------------------------- schedule
+    def chaos_schedule(
+        self,
+        site: str,
+        hosts,
+        total_s: float,
+        min_window_s: float = 0.2,
+        max_window_s: float = 0.8,
+    ):
+        """Yield a seeded sequence of (host, kind, magnitude, window_s,
+        idle_s) clock-fault windows covering ~total_s seconds. kind is
+        "skew" (± fractions of a second), "drift" (rate 0.25..3.0) or
+        "jump" (± seconds, enough to cross tick-burst and backward-
+        reading thresholds). The caller applies each window
+        (`apply(host, kind, magnitude)`, sleep window_s, `clear(host)`,
+        sleep idle_s) so clock chaos interleaves with crash/partition
+        orchestration. All draws ride the owning FaultPlane's `site`
+        stream — same-seeded reruns replay the schedule bit-identically
+        (schedule_signature)."""
+        budget = total_s
+        hosts = list(hosts)
+        plane = self.plane
+        while budget > 0:
+            host = plane.choice(site, "clock_host", hosts)
+            kind = plane.choice(
+                site, "clock_kind", ["skew", "drift", "jump"]
+            )
+            if kind == "skew":
+                mag = plane.uniform(site, "clock_skew_s", -0.25, 0.25)
+            elif kind == "drift":
+                mag = plane.uniform(site, "clock_rate", 0.25, 3.0)
+            else:
+                mag = plane.uniform(site, "clock_jump_s", -2.0, 2.0)
+            window = plane.uniform(
+                site, "clock_window", min_window_s, max_window_s
+            )
+            idle = plane.uniform(site, "clock_idle", 0.05, 0.3)
+            flight_recorder().record(
+                "clock_fault_window", site=site, host=host, kind=kind,
+                magnitude=round(mag, 4), window_s=round(window, 4),
+                seed=plane.seed,
+            )
+            yield host, kind, mag, window, idle
+            budget -= window + idle
+
+    def apply(self, host, kind: str, magnitude: float) -> None:
+        """Apply one schedule entry to the live clock."""
+        if kind == "drift":
+            self.set_drift(host, magnitude)
+        elif kind == "jump":
+            self.step_jump(host, magnitude)
+        else:
+            self.set_skew(host, magnitude)
+
+
 # message classes a chaos schedule usually wants to target (bulk data
 # plane) while the control plane keeps flowing
 REPLICATION_TYPES = frozenset(
@@ -547,6 +716,7 @@ REPLICATION_TYPES = frozenset(
 
 
 __all__ = [
+    "ClockPlane",
     "FaultPlane",
     "FaultSpec",
     "FaultyKV",

@@ -27,6 +27,7 @@ from .requests import (
     ErrClusterNotFound,
     ErrClusterNotReady,
     ErrInvalidSession,
+    ErrLeaseExpired,
     ErrRejected,
     ErrTimeout,
     RequestResult,
@@ -200,6 +201,13 @@ class NodeHost(IMessageHandler):
             )
         # --- tick loop
         self._tick_ms = cfg.rtt_millisecond
+        # injectable tick clock (faults.ClockPlane.clock_fn): the tick
+        # worker mints ticks off THIS clock, so injected skew/drift/
+        # step-jumps reach the tick plane exactly where a faulty machine
+        # clock would. Default is real monotonic time; anomaly detection
+        # only arms when a non-default clock is mounted.
+        self._tick_clock: Callable[[], float] = time.monotonic
+        self._clock_anomalies = 0
         self._tick_thread = threading.Thread(
             target=self._tick_worker_main, name="nh-tick", daemon=True
         )
@@ -769,6 +777,28 @@ class NodeHost(IMessageHandler):
         node = self._get_node(cluster_id)
         return node.sm.lookup(query)
 
+    def lease_read(self, cluster_id: int, query, timeout_s: float = 4.0):
+        """Lease-ONLY linearizable read probe: raises ErrLeaseExpired
+        immediately unless this host's replica holds a live leader lease
+        (latency-SLO callers that would rather retry elsewhere than pay
+        a quorum round). This is the one API that surfaces lease loss as
+        an error — sync_read never does; with Config.lease_read on it
+        serves off the lease when valid and silently degrades to the
+        ReadIndex quorum path when not. If the lease lapses between the
+        probe and the serve, the read degrades too: the outcome is
+        always linearizable, only the latency contract is lease-only."""
+        node = self._get_node(cluster_id)
+        valid = getattr(self.engine, "lease_valid", None)
+        if valid is None or not valid(cluster_id):
+            raise ErrLeaseExpired(
+                retry_after_s=self._tick_ms / 1000.0,
+                reason="no live leader lease on this replica",
+            )
+        rs = node.read(self._to_ticks(timeout_s))
+        r = rs.wait(timeout_s + 1.0)
+        self._unwrap(r)
+        return self.read_local_node(cluster_id, query)
+
     def stale_read(self, cluster_id: int, query):
         node = self._get_node(cluster_id)
         return node.sm.lookup(query)
@@ -1324,13 +1354,71 @@ class NodeHost(IMessageHandler):
         self.handle_snapshot_status(m.cluster_id, m.from_, False)
 
     # ------------------------------------------------------------- tick loop
+    def set_tick_clock(self, clock: Optional[Callable[[], float]]) -> None:
+        """Mount an injectable tick clock (faults.ClockPlane.clock_fn) —
+        or None to return to real monotonic time. The tick worker picks
+        the new clock up on its next iteration and re-anchors, so a
+        mount is never itself misread as a jump."""
+        self._tick_clock = clock or time.monotonic
+
+    def _on_clock_anomaly(self, hold_s: float) -> None:
+        """The tick clock read backward or diverged from real monotonic
+        elapsed — a clock fault, not a scheduling stall (a stall
+        advances both clocks equally). The caller sheds the phantom tick
+        backlog (no burst replay past the clamp); here we keep the
+        fairness gauge honest and put leases on suspect hold so reads
+        degrade to ReadIndex instead of trusting a lying clock."""
+        self._clock_anomalies += 1
+        wd = getattr(self.engine, "watchdog", None)
+        if wd is not None:
+            try:
+                wd.note_clock_anomaly()
+            except Exception:
+                pass
+        suspect = getattr(self.engine, "set_clock_suspect", None)
+        if suspect is not None:
+            try:
+                suspect(hold_s)
+            except Exception:
+                pass
+
     def _tick_worker_main(self) -> None:
         """cf. nodehost.go:1668-1684 tickWorkerMain."""
         period = self._tick_ms / 1000.0
-        next_t = time.monotonic() + period
-        next_gauges_t = time.monotonic() + 1.0
+        # a tick-clock reading that diverges from REAL monotonic elapsed
+        # by more than this (since the last anchor) is a clock fault;
+        # divergence below it replays as a bounded, clamp-safe backlog
+        divergence_limit = max(8 * period, 0.05)
+        # lease-suspect hold after an anomaly: comfortably past one
+        # election RTT at default tick rates, so a healed clock must
+        # re-earn its lease with a full quorum round
+        suspect_hold_s = max(0.25, 32 * period)
+        clock = self._tick_clock
+        anchor_real = time.monotonic()
+        anchor_fault = clock()
+        next_t = anchor_fault + period
+        next_gauges_t = anchor_fault + 1.0
+        last_now = anchor_fault
         while not self._stopped.is_set():
-            now = time.monotonic()
+            if clock is not self._tick_clock:
+                # live (un)mount: re-anchor, never misread as a jump
+                clock = self._tick_clock
+                anchor_real = time.monotonic()
+                anchor_fault = clock()
+                next_t = anchor_fault + period
+                last_now = anchor_fault
+            now = clock()
+            if clock is not time.monotonic:
+                real = time.monotonic()
+                div = (now - anchor_fault) - (real - anchor_real)
+                if now < last_now or abs(div) > divergence_limit:
+                    self._on_clock_anomaly(suspect_hold_s)
+                    anchor_real, anchor_fault = real, now
+                    next_t = now + period  # resync: shed phantom backlog
+                    next_gauges_t = min(next_gauges_t, now + 1.0)
+                    last_now = now
+                    continue
+            last_now = now
             if now >= next_gauges_t:
                 next_gauges_t = now + 1.0
                 try:

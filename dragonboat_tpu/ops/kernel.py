@@ -116,6 +116,12 @@ def _reset(s: RaftTensors, new_term, keep_term_vote=False) -> RaftTensors:
         ri_index=jnp.zeros_like(s.ri_index),
         ri_acks=jnp.zeros_like(s.ri_acks),
         ri_count=jnp.zeros_like(s.ri_count),
+        # any role transition revokes the lease outright — new leadership
+        # must re-earn it via a fresh quorum heartbeat round (scalar: the
+        # lease clears in core.raft._reset)
+        lease_until=jnp.zeros_like(s.lease_until),
+        hb_round_tick=jnp.zeros_like(s.hb_round_tick),
+        hb_ack_bits=jnp.zeros_like(s.hb_ack_bits),
         match=jnp.where(selfm, last[:, None], 0),
         next=jnp.broadcast_to((last + 1)[:, None], s.next.shape),
         rstate=jnp.zeros_like(s.rstate),
@@ -527,6 +533,8 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
         ),
     )
     resp_type = jnp.where(hb, MSG.HEARTBEAT_RESP, resp_type)
+    # echo the leader's lease round tag (log_index, 0 when leases off)
+    resp_log_index = jnp.where(hb, m["log_index"], resp_log_index)
     resp_hint = jnp.where(hb, m["hint"], resp_hint)
     resp_hint2 = jnp.where(hb, m["hint_high"], resp_hint2)
 
@@ -614,6 +622,37 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )
     frombit = (jnp.int32(1) << from_slot)[:, None]
     s = s._replace(ri_acks=jnp.where(hint_match, s.ri_acks | frombit, s.ri_acks))
+    # lease round ack (scalar: _handle_leader_heartbeat_resp): the follower
+    # echoed the open round's tick tag in log_index; collect voting acks and
+    # at quorum extend the lease to round-start + election_timeout - margin —
+    # strictly inside the window in which no other node can win an election
+    tag_match = (
+        hr
+        & s.lease_on
+        & (m["log_index"] != 0)
+        & (m["log_index"] == s.hb_round_tick)
+        & jnp.any(fr & s.voting, axis=1)
+    )
+    new_bits = jnp.where(tag_match, s.hb_ack_bits | frombit[:, 0], s.hb_ack_bits)
+    ackn = _popcount(new_bits)
+    grant = (
+        hr
+        & s.lease_on
+        & s.clock_ok
+        & (s.hb_round_tick != 0)
+        & (ackn + 1 >= _quorum(s))
+    )
+    s = s._replace(
+        hb_ack_bits=new_bits,
+        lease_until=jnp.where(
+            grant,
+            jnp.maximum(
+                s.lease_until,
+                s.hb_round_tick + s.election_timeout - s.lease_margin,
+            ),
+            s.lease_until,
+        ),
+    )
 
     # ---- ReadIndex (leader) ------------------------------------------------
     ri = act & (mtype == MSG.READ_INDEX) & (s.role == ROLE.LEADER)
@@ -622,7 +661,19 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     committed_this_term = _term_at(s, s.committed) == s.term
     ok_ri = ri & (single | committed_this_term)
     slot_free = s.ri_count < R
-    enq = ok_ri & ~single & slot_free
+    # lease fast path: a live lease makes the local committed index the
+    # linearization point — the read rides the immediate-ready mechanism
+    # (acks = -1) instead of opening a quorum heartbeat round. Expired /
+    # revoked / suspect lanes fall through to the quorum path below
+    # (degradation, not danger).
+    lease_valid = (
+        s.lease_on
+        & s.clock_ok
+        & (s.tick_count < s.lease_until)
+        & (s.transfer_to == 0)
+    )
+    imm_lease = ok_ri & ~single & lease_valid & slot_free
+    enq = ok_ri & ~single & ~lease_valid & slot_free
     pos = s.ri_count
     posm = jax.nn.one_hot(pos, R, dtype=bool) & enq[:, None]
     s = s._replace(
@@ -643,8 +694,9 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     out["send_hint2"] = jnp.where(
         enq[:, None] & others_v, m["hint_high"][:, None], out["send_hint2"]
     )
-    # single-node: instantly ready (delivered via the ready queue at step end)
-    imm = ok_ri & single
+    # single-node or lease-served: instantly ready (delivered via the ready
+    # queue at step end)
+    imm = (ok_ri & single) | imm_lease
     posm2 = jax.nn.one_hot(s.ri_count, R, dtype=bool) & imm[:, None]
     s = s._replace(
         ri_ctx=jnp.where(posm2, m["hint"][:, None], s.ri_ctx),
@@ -655,6 +707,10 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     )
     out["dropped_readindex"] = out["dropped_readindex"] + jnp.where(
         (ri & ~ok_ri) | (ok_ri & ~single & ~slot_free), 1, 0
+    )
+    out["lease_served"] = out["lease_served"] + jnp.where(imm_lease, 1, 0)
+    out["lease_fallback"] = out["lease_fallback"] + jnp.where(
+        enq & s.lease_on, 1, 0
     )
 
     # ---- Propose (leader) --------------------------------------------------
@@ -844,6 +900,15 @@ def _tick(s: RaftTensors, ticks, out):
     s = s._replace(heartbeat_tick=s.heartbeat_tick + jnp.where(do & is_leader, ticks, 0))
     hb_due = do & is_leader & (s.heartbeat_tick >= s.heartbeat_timeout)
     s = s._replace(heartbeat_tick=jnp.where(hb_due, 0, s.heartbeat_tick))
+    # open a new lease round, tagged with the just-advanced tick count:
+    # followers echo the tag in HEARTBEAT_RESP.log_index and quorum acks
+    # grant the lease (HeartbeatResp handler). tick_count >= 1 by the time
+    # any heartbeat fires, so tag 0 always reads "no round / leases off".
+    open_round = hb_due & s.lease_on
+    s = s._replace(
+        hb_round_tick=jnp.where(open_round, s.tick_count, s.hb_round_tick),
+        hb_ack_bits=jnp.where(open_round, 0, s.hb_ack_bits),
+    )
     # heartbeat to voting members; with a pending readindex ctx attach the
     # newest ctx as hint (raft.go:828-846)
     R = s.ri_ctx.shape[1]
@@ -893,6 +958,8 @@ def step_batch(
         "noop_term": jnp.zeros((G,), i32),
         "dropped_propose": jnp.zeros((G,), i32),
         "dropped_readindex": jnp.zeros((G,), i32),
+        "lease_served": jnp.zeros((G,), i32),
+        "lease_fallback": jnp.zeros((G,), i32),
         "dropped_cc": jnp.zeros((G,), bool),
         "fwd_leader": jnp.zeros((G,), i32),
         "log_full": jnp.zeros((G,), bool),
@@ -1142,6 +1209,15 @@ def step_batch(
         rstate=s.rstate,
         last_index=s.last_index,
         quiesced=s.quiesced,
+        lease_round=jnp.where(
+            s.lease_on & (s.role == ROLE.LEADER), s.hb_round_tick, 0
+        ),
+        lease_served=out["lease_served"],
+        lease_fallback=out["lease_fallback"],
+        lease_ok=(
+            s.lease_on & s.clock_ok & (s.role == ROLE.LEADER)
+            & (s.tick_count < s.lease_until) & (s.transfer_to == 0)
+        ),
     )
     return s, output
 
@@ -1295,8 +1371,12 @@ def _route_columns(s: RaftTensors, out: StepOutput, route, rdelta, cfg):
             false_gp, out.send_hint, zero_gp, zero_gp, no_ents_gp, no_cc_gp,
         ),
         (
+            # log_index carries the lease round tag — an opaque tick stamp
+            # the follower echoes back verbatim, so NO rdelta translation
+            # (0 when leases off, matching the host wire path)
             hb_want, route, jnp.full((G, P), MSG.HEARTBEAT, i32), self_gp,
-            term_gp, zero_gp, zero_gp,
+            term_gp, jnp.broadcast_to(out.lease_round[:, None], (G, P)),
+            zero_gp,
             jnp.maximum(out.send_hb_commit + rdelta, 0), false_gp,
             out.send_hint, out.send_hint2, zero_gp, no_ents_gp, no_cc_gp,
         ),
@@ -1309,7 +1389,13 @@ def _route_columns(s: RaftTensors, out: StepOutput, route, rdelta, cfg):
             resp_want, resp_dest, out.resp_type,
             jnp.broadcast_to(self_col, (G, K)),
             out.resp_term,
-            jnp.where(is_rresp, out.resp_log_index + resp_delta, 0),
+            # HEARTBEAT_RESP echoes the lease round tag untranslated (an
+            # opaque tick stamp, not an index — no resp_delta)
+            jnp.where(
+                is_rresp,
+                out.resp_log_index + resp_delta,
+                jnp.where(is_hbresp, out.resp_log_index, 0),
+            ),
             zero_gk, zero_gk,
             out.resp_reject
             & (

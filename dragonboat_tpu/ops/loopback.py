@@ -65,6 +65,8 @@ class LoopbackCluster:
         observers: Tuple[int, ...] = (),
         seed: int = 1,
         prevote: bool = False,
+        lease_read: bool = False,
+        lease_margin: int = 0,
     ) -> None:
         self.cfg = cfg or KernelConfig(
             groups=n_groups, peers=max(n_replicas, 2), inbox_depth=8
@@ -92,6 +94,8 @@ class LoopbackCluster:
                     is_observer=h in observers,
                     is_witness=h in witnesses,
                     prevote=prevote,
+                    lease_read=lease_read,
+                    lease_margin=lease_margin,
                 )
             self.states.append(st)
         # pending[replica][group] = list of Msg
@@ -208,6 +212,7 @@ class LoopbackCluster:
         ready_ctx2 = np.asarray(out.ready_ctx2)
         ready_idx = np.asarray(out.ready_index)
         ready_n = np.asarray(out.ready_count)
+        lease_round = np.asarray(out.lease_round)
         for g in range(self.n_groups):
             for n in range(int(ready_n[g])):
                 self.ready_reads[h].append(
@@ -237,6 +242,10 @@ class LoopbackCluster:
                         h, p, g,
                         Msg(
                             MSG.HEARTBEAT, from_slot=h, term=int(term[g]),
+                            # the lease round tag rides the heartbeat's
+                            # otherwise-unused log_index (0 = leases off),
+                            # exactly like the engine wire path
+                            log_index=int(lease_round[g]),
                             commit=int(hb_commit[g, p]), hint=int(hint[g, p]),
                             hint_high=int(hint2[g, p]),
                         ),

@@ -117,6 +117,16 @@ class RaftTensors(NamedTuple):
     # reach PRE_CANDIDATE — the False path is bit-identical to the
     # pre-knob kernel
     prevote_on: jax.Array  # bool[G]
+    # leader-lease read gate (Config.lease_read): lanes with lease_on
+    # clear can never open a lease round — the False path is bit-identical
+    # to the pre-knob kernel. Lease bookkeeping is tick-denominated (NOT
+    # log-index-denominated): none of these fields participate in rebase.
+    lease_on: jax.Array  # bool[G]
+    lease_margin: jax.Array  # i32[G] clock-skew margin (ticks)
+    lease_until: jax.Array  # i32[G] lease live while tick_count < this
+    hb_round_tick: jax.Array  # i32[G] tick tag of the open heartbeat round
+    hb_ack_bits: jax.Array  # i32[G] bitmask of peer slots acking that round
+    clock_ok: jax.Array  # bool[G] host clears while the tick clock is suspect
     # log metadata (rebased int32 indexes)
     first_index: jax.Array  # i32[G] lowest index with term in the ring
     marker_term: jax.Array  # i32[G] term at first_index-1 (snapshot/compaction marker)
@@ -240,6 +250,13 @@ class StepOutput(NamedTuple):
     last_index: jax.Array  # i32[G]
     quiesced: jax.Array  # bool[G] lane idle-frozen (host packs a wake NOOP
     #   before staging work for a quiesced lane)
+    # lease plane: lease_round rides outbound heartbeats as the wire tag
+    # (Message.log_index, 0 when leases off); the counters are per-step
+    # deltas the host accumulates into engine lease_stats()
+    lease_round: jax.Array  # i32[G] open heartbeat-round tag for wire stamp
+    lease_served: jax.Array  # i32[G] reads served locally off the lease
+    lease_fallback: jax.Array  # i32[G] lease-on reads that fell back to quorum
+    lease_ok: jax.Array  # bool[G] lane holds a live lease after this step
 
 
 class RoutePlan(NamedTuple):
@@ -287,6 +304,12 @@ def init_state(cfg: KernelConfig) -> RaftTensors:
         heartbeat_timeout=jnp.full((G,), 1, i32),
         check_quorum=f_g(),
         prevote_on=f_g(),
+        lease_on=f_g(),
+        lease_margin=z_g(),
+        lease_until=z_g(),
+        hb_round_tick=z_g(),
+        hb_ack_bits=z_g(),
+        clock_ok=jnp.ones((G,), bool),
         first_index=jnp.ones((G,), i32),
         marker_term=z_g(),
         last_index=z_g(),
@@ -354,6 +377,8 @@ def configure_group(
     is_observer: bool = False,
     is_witness: bool = False,
     prevote: bool = False,
+    lease_read: bool = False,
+    lease_margin: int = 0,
 ) -> RaftTensors:
     """Host-side reconcile: activate lane g with the given membership.
     Rare-path (StartCluster / config change), so clarity over speed."""
@@ -395,6 +420,8 @@ def configure_group(
         ),
         "check_quorum": state.check_quorum.at[g].set(check_quorum),
         "prevote_on": state.prevote_on.at[g].set(prevote),
+        "lease_on": state.lease_on.at[g].set(lease_read),
+        "lease_margin": state.lease_margin.at[g].set(lease_margin),
     }
     return state._replace(**upd)
 
@@ -407,6 +434,8 @@ def configure_groups_uniform(
     heartbeat_timeout: int = 1,
     check_quorum: bool = False,
     prevote: bool = False,
+    lease_read: bool = False,
+    lease_margin: int = 0,
 ) -> RaftTensors:
     """Vectorized configure for ALL lanes with identical membership shape —
     one whole-array update instead of G scalar dispatches. This is the bulk
@@ -441,6 +470,8 @@ def configure_groups_uniform(
         rand_timeout=jnp.asarray(rand_to),
         check_quorum=jnp.full((G,), check_quorum, bool),
         prevote_on=jnp.full((G,), prevote, bool),
+        lease_on=jnp.full((G,), lease_read, bool),
+        lease_margin=jnp.full((G,), lease_margin, jnp.int32),
     )
 
 

@@ -106,6 +106,27 @@ class ErrMigrationAborted(ErrSystemBusy):
         self.reason = reason
 
 
+class ErrLeaseExpired(ErrSystemBusy):
+    """The lease-only read probe (NodeHost.lease_read) found no live
+    leader lease on this replica — expired, revoked by step-down or
+    leadership transfer, or suspended by a clock-anomaly report from the
+    tick plane. This error is raised ONLY by the explicit lease-only
+    probe API; the normal linearizable read path never surfaces it — an
+    invalid lease there silently degrades to the ReadIndex quorum round
+    (degradation, not danger). Subclasses ErrSystemBusy so
+    serving.retry.call_with_retries retries it automatically, honoring
+    `retry_after_s` (sized to roughly one heartbeat interval: the next
+    quorum heartbeat round is what re-arms the lease) as the backoff
+    floor."""
+
+    code = "no live leader lease, read via ReadIndex instead"
+
+    def __init__(self, retry_after_s: float = 0.0, reason: str = ""):
+        super().__init__(reason or self.code)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+
+
 class ErrInvalidSession(RequestError):
     code = "invalid session"
 
@@ -789,6 +810,7 @@ __all__ = [
     "ErrRejected",
     "ErrSystemBusy",
     "ErrMigrationAborted",
+    "ErrLeaseExpired",
     "ErrInvalidSession",
     "ErrTimeoutTooSmall",
     "ErrPayloadTooBig",

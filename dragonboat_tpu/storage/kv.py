@@ -348,6 +348,10 @@ class WalKV(IKVStore):
         self._since_compact = 0
         # fsync-latency observer (cb(seconds)); None = zero extra work
         self._fsync_observer: Optional[Callable[[float], None]] = None
+        # per-record append fault seam (FaultPlane.maybe_append_fault):
+        # called before each record write; raising aborts the batch and
+        # MUST roll the file back past the half-written group
+        self._append_fault: Optional[Callable[[], None]] = None
         # per-store barrier-pressure gauge: one NodeHost's saturation
         # must never shed another co-hosted NodeHost's traffic, so
         # ShardedLogDB.barrier_stats() aggregates THESE per host while
@@ -356,6 +360,9 @@ class WalKV(IKVStore):
 
     def set_fsync_observer(self, cb: Optional[Callable[[float], None]]) -> None:
         self._fsync_observer = cb
+
+    def set_append_fault(self, cb: Optional[Callable[[], None]]) -> None:
+        self._append_fault = cb
 
     def _barrier(self) -> None:
         """The durability barrier: always timed into the process-global
@@ -414,12 +421,42 @@ class WalKV(IKVStore):
         rec = _REC.pack(_REC.size + len(k) + len(v) + 4, op, len(k), len(v)) + k + v
         self._f.write(rec + struct.pack("<I", zlib.crc32(rec)))
 
-    def commit_write_batch(self, wb: WriteBatch) -> None:
-        with self._mu:
+    def _append_group(self, wb: WriteBatch) -> None:
+        """Append wb's records + the commit seal as one group; on ANY
+        append failure roll the file back to the pre-group offset before
+        re-raising. Without the rollback the unsealed records would sit
+        at the tail and the NEXT batch's seal would merge them into its
+        group — resurrecting a batch the caller was told failed. Caller
+        holds self._mu."""
+        start = self._f.tell()
+        try:
+            fault = self._append_fault
             for op, k, v in wb.ops:
+                if fault is not None:
+                    fault()
                 self._append_rec(op, k, v)
             self._append_rec(_OP_COMMIT, b"", b"")  # seal the group
             self._f.flush()
+        except BaseException:
+            try:
+                self._f.flush()
+                self._f.truncate(start)
+            except Exception:
+                # the unwind itself failed (e.g. the flush hit the same
+                # disk error): reopen and truncate via a fresh descriptor
+                # so no half-written group survives this fd's buffer
+                try:
+                    self._f.close()
+                except Exception:
+                    pass
+                with open(self._path, "r+b") as f:
+                    f.truncate(start)
+                self._f = open(self._path, "ab")
+            raise
+
+    def commit_write_batch(self, wb: WriteBatch) -> None:
+        with self._mu:
+            self._append_group(wb)
             if self._fsync:
                 self._barrier()
             self._mem.commit_write_batch(wb)
@@ -430,10 +467,7 @@ class WalKV(IKVStore):
         caller groups barriers across shards into one parallel wave. The
         batch is NOT durable until that sync() returns."""
         with self._mu:
-            for op, k, v in wb.ops:
-                self._append_rec(op, k, v)
-            self._append_rec(_OP_COMMIT, b"", b"")  # seal the group
-            self._f.flush()
+            self._append_group(wb)
             self._mem.commit_write_batch(wb)
             self._since_compact += len(wb.ops)
         return self._fsync

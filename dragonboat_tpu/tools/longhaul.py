@@ -57,7 +57,7 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from ..config import Config, EngineConfig, NodeHostConfig
-from ..faults import FaultPlane, FaultSpec
+from ..faults import ClockPlane, FaultPlane, FaultSpec
 from ..lincheck import HistoryRecorder, check_kv_history
 from ..nodehost import NodeHost
 from ..requests import RequestError
@@ -93,6 +93,7 @@ SCENARIOS = (
     "prevote_rejoin_storm",
     "streamed_install_under_crash",
     "rebalance_under_load",
+    "lease_clock_chaos",
     "none",
 )
 
@@ -176,9 +177,13 @@ def _mk_host(
     run_dir: str,
     opts: Options,
     fp: FaultPlane,
+    cp: Optional[ClockPlane] = None,
 ) -> NodeHost:
     """One loopback NodeHost on a durable dir (h<nid> under the round
-    dir) with its shard WALs wrapped for seeded fsync-fault injection."""
+    dir) with its shard WALs wrapped for seeded fsync-fault injection
+    and its tick worker mounted on the round's injectable clock plane
+    (clock state is keyed by host id, so a restarted process inherits
+    the machine's — possibly still faulted — clock)."""
 
     def logdb_factory(d, _nid=nid):
         return ShardedLogDB(
@@ -204,6 +209,8 @@ def _mk_host(
         ),
     )
     nh = NodeHost(cfg)
+    if cp is not None:
+        nh.set_tick_clock(cp.clock_fn(nid))
     if nid in HOSTS:
         members = {h: f"c{h}:1" for h in HOSTS}
         nh.start_cluster(
@@ -234,8 +241,15 @@ def _member_config(nid: int, **overrides) -> Config:
         compaction_overhead=10,
         pre_vote=True,
         check_quorum=True,
+        # leader leases ON for the whole soak: every read in the client
+        # mix rides the lease fast path when live and MUST silently
+        # degrade to ReadIndex under the clock-chaos scenario — the
+        # lincheck verdict judges both paths in one history
+        lease_read=True,
     )
     kw.update(overrides)
+    if kw.get("is_observer") or kw.get("is_witness"):
+        kw["lease_read"] = False  # lane variants can never serve leases
     return Config(**kw)
 
 
@@ -304,6 +318,9 @@ class _Round:
         self.fp = FaultPlane(
             seed, FaultSpec(drop=0.25, tear_tail=0.5)
         )
+        # clock faults ride the SAME plane (seed + schedule signature);
+        # every host's tick worker mounts this plane's per-host clock
+        self.cp = ClockPlane(self.fp)
         self.reg = _Registry()
         self.hosts: Dict[int, Optional[NodeHost]] = {}
         self.result = RoundResult(round_no=round_no, seed=seed)
@@ -340,6 +357,18 @@ class _Round:
             "runs": 0, "completed": 0, "aborted": 0,
             "lincheck_ok": True, "urgent_shed": 0,
         }
+        # lease/clock-chaos ledger: windows = clock faults applied,
+        # big_faults = faults past the tick worker's divergence limit
+        # applied to the live leader (those MUST surface as ReadIndex
+        # fallbacks, never as stale reads), burst_reads = lease-path
+        # reads recorded into the round history during fault windows,
+        # local/fallback = engine lease-counter deltas across the bursts
+        self._lease = {
+            "windows": 0, "big_faults": 0, "burst_reads": 0,
+            "local": 0, "fallback": 0,
+        }
+        self._clock_gen = None
+        self._rec: Optional[HistoryRecorder] = None
 
     # ------------------------------------------------------------ lifecycle
     def run(self) -> RoundResult:
@@ -353,11 +382,12 @@ class _Round:
             except Exception:
                 pass  # forensics must never block the run
         rec = HistoryRecorder()
+        self._rec = rec  # lease burst reads record into the SAME history
         stop = threading.Event()
         try:
             for nid in HOSTS + (CHURN_HOST,):
                 self.hosts[nid] = _mk_host(
-                    nid, self.reg, self.dir, self.opts, self.fp
+                    nid, self.reg, self.dir, self.opts, self.fp, self.cp
                 )
             # warmup barrier: bring-up (incl. the cold kernel compile on
             # the vector step loop) is not part of the measured fault
@@ -465,7 +495,7 @@ class _Round:
                 self.fp.tear_wal_tails(ldir, f"tear:h{victim}")
             time.sleep(down)
             self.hosts[victim] = _mk_host(
-                victim, self.reg, self.dir, self.opts, self.fp
+                victim, self.reg, self.dir, self.opts, self.fp, self.cp
             )
         time.sleep(idle)
 
@@ -870,6 +900,86 @@ class _Round:
                 except Exception:
                     pass
 
+    def _op_lease_clock_chaos(self) -> None:
+        """Clock-fault window + lease-read burst: apply one seeded
+        skew/drift/step-jump from the ClockPlane schedule to the LIVE
+        LEADER's host clock, then drive a burst of linearizable reads
+        (recorded into the round history) while the window is open. A
+        fault past the tick worker's divergence limit trips the clock
+        anomaly path — lease revoked + suspect hold — so every burst
+        read MUST come back via the ReadIndex fallback (counted by
+        lease_stats), never as a stale lease read; milder faults leave
+        the lease serving locally. Both outcomes are judged by the one
+        lincheck over the round history."""
+        # draws FIRST (replay determinism, see _op_transfer)
+        if self._clock_gen is None:
+            self._clock_gen = self.cp.chaos_schedule(
+                "longhaul", list(HOSTS), total_s=1e9,
+            )
+        drawn, kind, mag, window, idle = next(self._clock_gen)
+        n_reads = int(self.fp.uniform("longhaul", "lease_reads", 8.0, 20.0))
+        leader = _find_leader(self.hosts, deadline_s=3.0)
+        victim = leader if leader is not None else drawn
+        if self.hosts.get(victim) is None:
+            return
+        st = self._lease
+        st["windows"] += 1
+        # mirror of NodeHost._tick_worker_main's divergence limit
+        # (rtt=5ms -> max(8*0.005, 0.05) = 0.05s), with headroom so a
+        # draw just past the line never flakes the verdict; drift
+        # divergence accumulates at |rate-1| per real second
+        big = (
+            kind in ("skew", "jump") and abs(mag) > 0.08
+            or kind == "drift" and abs(mag - 1.0) * window > 0.08
+        ) and leader is not None
+        if big:
+            st["big_faults"] += 1
+        before = self._lease_counts()
+        self.cp.apply(victim, kind, mag)
+        rec = self._rec
+        deadline = time.monotonic() + window
+        done = 0
+        while done < n_reads and time.monotonic() < deadline + 2.0:
+            lid = _find_leader(self.hosts, deadline_s=2.0)
+            lnh = self.hosts.get(lid) if lid is not None else None
+            if lnh is None:
+                continue
+            key = KEYS[done % len(KEYS)]
+            op = rec.invoke(70 + victim, ("get", key))
+            try:
+                val = lnh.sync_read(CLUSTER, key, timeout_s=2.0)
+                rec.complete(op, val)
+            except Exception:
+                rec.fail(op)  # reads have no side effect
+            done += 1
+        st["burst_reads"] += done
+        left = deadline - time.monotonic()
+        if left > 0:
+            time.sleep(left)
+        self.cp.clear(victim)
+        after = self._lease_counts()
+        st["local"] += max(after[0] - before[0], 0)
+        st["fallback"] += max(after[1] - before[1], 0)
+        time.sleep(idle)
+
+    def _lease_counts(self) -> tuple:
+        """(local, fallback) lease-read totals across live hosts' engines
+        (a crashed host's counters restart at zero; deltas clamp at 0)."""
+        local = fb = 0
+        for nh in self.hosts.values():
+            if nh is None:
+                continue
+            stats = getattr(nh.engine, "lease_stats", None)
+            if stats is None:
+                continue
+            try:
+                d = stats()
+            except Exception:
+                continue
+            local += d["local"]
+            fb += d["fallback"]
+        return local, fb
+
     def _urgent_sheds(self) -> int:
         """POLICY sheds of the urgent class across every live host's
         serving front (the migration verdict's no-starvation probe)."""
@@ -939,7 +1049,7 @@ class _Round:
             vnh.crash()
             time.sleep(0.1)
             self.hosts[victim] = _mk_host(
-                victim, self.reg, self.dir, self.opts, self.fp
+                victim, self.reg, self.dir, self.opts, self.fp, self.cp
             )
         else:
             vnh.restart_cluster(CLUSTER)
@@ -950,10 +1060,12 @@ class _Round:
         """Heal every fault, restart every down host/node, and shed the
         churn member so the 3-way convergence checks see a clean group."""
         self.fp.uninstall_all()
+        for h in HOSTS + (CHURN_HOST,):
+            self.cp.clear(h)  # continuous heal: rate 1.0, no jump
         for nid in HOSTS:
             if self.hosts.get(nid) is None:
                 self.hosts[nid] = _mk_host(
-                    nid, self.reg, self.dir, self.opts, self.fp
+                    nid, self.reg, self.dir, self.opts, self.fp, self.cp
                 )
             nh = self.hosts[nid]
             nh.set_partitioned(False)
@@ -1081,6 +1193,20 @@ class _Round:
         if self._mig["runs"]:
             v["migration_lincheck"] = self._mig["lincheck_ok"]
             v["migration_no_urgent_shed"] = self._mig["urgent_shed"] == 0
+        # lease reads under clock chaos (only when the scenario fired):
+        # the burst reads recorded during fault windows are part of the
+        # one round history, so "linearizable" is the SAME lincheck —
+        # the verdict additionally requires the bursts actually ran.
+        # When a fault big enough to trip the tick worker's divergence
+        # limit hit the live leader, the degradation contract must show:
+        # reads kept serving through the ReadIndex fallback (never a
+        # stale lease read, never an error surfaced to sync_read)
+        if self._lease["windows"]:
+            v["lease_reads_linearizable"] = (
+                v["lincheck"] and self._lease["burst_reads"] > 0
+            )
+            if self._lease["big_faults"]:
+                v["lease_fallback_served"] = self._lease["fallback"] > 0
 
     # ------------------------------------------------------------ artifacts
     def _bundle_failure(self) -> None:

@@ -163,6 +163,14 @@ def _workload(cfg: dict) -> str:
     return "through_front" if cfg.get("session_mode") else "raw"
 
 
+def _read_mode(cfg: dict) -> str:
+    """The config's read path: 'lease' serves linearizable reads off the
+    leader lease (no quorum round per read), 'readindex' pays the
+    ReadIndex confirmation. Records that predate the stamp ran
+    ReadIndex by construction (leases did not exist)."""
+    return str(cfg.get("read_mode") or "readindex")
+
+
 def _mesh(cfg: dict) -> Tuple[int, Tuple[int, ...]]:
     """The config's device mesh: (n_devices, mesh_shape). Records that
     predate the stamp ran unsharded single-device engines — (1, (1,))
@@ -268,6 +276,22 @@ def compare_config(
                 f"workload mismatch: old measured '{ow}', new measured "
                 f"'{nw}'; admitted-front throughput and raw "
                 "propose_batch throughput are different machines"
+            ],
+        }
+    # ---- honesty: lease reads vs ReadIndex is a different read path ---
+    # a lease-mode reads/s number "beating" a ReadIndex-mode number is
+    # the POINT of the lease feature, not a perf delta of the same code;
+    # and a lease run "regressing" against itself after a fallback-heavy
+    # window would misread degradation as a code change (same rule shape
+    # as the scaled-down / K / workload refusals)
+    orm, nrm = _read_mode(old), _read_mode(new)
+    if orm != nrm:
+        return {
+            "verdict": INCOMPARABLE,
+            "reasons": [
+                f"read_mode mismatch: old measured '{orm}' reads, new "
+                f"measured '{nrm}'; lease-served and ReadIndex-confirmed "
+                "reads are different read paths"
             ],
         }
     # ---- honesty: a different device mesh is a different machine ------

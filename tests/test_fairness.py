@@ -190,3 +190,31 @@ def test_nodehost_exports_starvation_gauges():
         assert "engine_tick_starvation_ratio" in text
     finally:
         nh.stop()
+
+
+def test_clock_anomaly_discards_phantom_gap_but_keeps_lifetime_max():
+    """A tick-plane clock anomaly (step-jump/backward read) mints a
+    PHANTOM gap in the stall gauge; note_clock_anomaly must discard the
+    window (the fault is a lying clock, not a starved loop — chaos runs'
+    fairness_no_stall verdict must not trip on it) while the lifetime
+    max and the anomaly counter stay honest."""
+    clock = FakeClock()
+    wd = FairnessWatchdog("a", tick_period_s=0.005, clock=clock)
+    try:
+        t0 = wd.iter_begin()
+        clock.t += 5.0  # the jumped clock mints a 1000-period gap
+        wd.iter_end(t0)
+        assert wd.stats()["starvation_ratio"] > 100
+        wd.note_clock_anomaly()
+        s = wd.stats()
+        assert s["clock_anomalies"] == 1
+        assert s["recent_max_gap_s"] == 0.0  # phantom gap discarded
+        assert s["starvation_ratio"] == 0.0
+        assert s["max_gap_s"] >= 5.0  # lifetime max stays honest
+        # the re-anchored beat measures fresh gaps normally afterwards
+        t0 = wd.iter_begin()
+        clock.t += 0.004
+        wd.iter_end(t0)
+        assert 0 < wd.stats()["starvation_ratio"] < 1.0
+    finally:
+        wd.close()

@@ -152,6 +152,9 @@ def _random_output(rng: random.Random, lane_by_g, base):
         "save_to": np.zeros((G,), np.int32),
         "commit_index": i32((G,), 0, W - 2),
         "hard_changed": np.asarray(rng_ints(rng, (G,), 0, 1), bool),
+        # opaque lease round tag: rides heartbeat log_index verbatim
+        # (no base translation; 0 = leases off)
+        "lease_round": i32((G,), 0, 1 << 16),
     }
     flag_choices = (
         0, 0, SEND_REPLICATE, SEND_HEARTBEAT, SEND_VOTE_REQ,
@@ -246,6 +249,8 @@ def _ref_post(o, base, lane_by_g):
                     type=MT.HEARTBEAT, cluster_id=lane.node.cluster_id,
                     to=to_nid, from_=lane.node.node_id(),
                     term=int(o["term"][g]),
+                    # lease round tag: untranslated (not an index)
+                    log_index=int(o["lease_round"][g]),
                     commit=int(base[g]) + int(o["send_hb_commit"][g, p]),
                     hint=int(o["send_hint"][g, p]),
                     hint_high=int(o["send_hint2"][g, p]),

@@ -368,3 +368,108 @@ def test_acceptance_drop_and_partition_schedule(tmp_path):
         assert m["queue_dropped_urgent"] == 0, (nid, m)
     for nh in hosts.values():
         nh.stop()
+
+
+def test_faulty_kv_append_error_never_half_seals_group(tmp_path):
+    """ISSUE 17 satellite: a write-path EIO mid-batch (append_error, not
+    fsync_error) must never leave a half-sealed record group — after the
+    failure AND after reopen the failed batch is invisible as a unit,
+    earlier data is intact, and the store keeps working."""
+    from dragonboat_tpu.faults import FaultPlane as FP
+
+    d = str(tmp_path / "w")
+    kv = WalKV(d, fsync=False)
+    wb0 = WriteBatch()
+    wb0.put(b"stable", b"yes")
+    kv.commit_write_batch(wb0)
+    # a counting fault: fail on the SECOND record of the batch, so the
+    # first record is already in the file when the group unwinds
+    calls = {"n": 0}
+
+    def fault():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise IOError("injected append error")
+
+    kv.set_append_fault(fault)
+    wb = WriteBatch()
+    wb.put(b"half", b"a")
+    wb.put(b"half2", b"b")
+    with pytest.raises(IOError):
+        kv.commit_write_batch(wb)
+    kv.set_append_fault(None)
+    # in the LIVE store: nothing of the failed group is visible and the
+    # unwind did not eat the earlier sealed group
+    assert kv.get_value(b"half") is None and kv.get_value(b"half2") is None
+    assert kv.get_value(b"stable") == b"yes"
+    # the truncated tail accepts new groups cleanly
+    wb2 = WriteBatch()
+    wb2.put(b"after", b"ok")
+    kv.commit_write_batch(wb2)
+    kv.close()
+    # after REOPEN (the WAL replay): same story, no half-sealed group
+    kv2 = WalKV(d)
+    assert kv2.get_value(b"half") is None and kv2.get_value(b"half2") is None
+    assert kv2.get_value(b"stable") == b"yes"
+    assert kv2.get_value(b"after") == b"ok"
+    kv2.close()
+    # the seeded plane arms the same seam through wrap_kv
+    fp = FP(9, FaultSpec(append_error=1.0))
+    kv3 = fp.wrap_kv(WalKV(d, fsync=False), "crash:h1")
+    wb3 = WriteBatch()
+    wb3.put(b"nope", b"x")
+    with pytest.raises(IOError):
+        kv3.commit_write_batch(wb3)
+    fp.set_spec(FaultSpec())  # heal
+    kv3.commit_write_batch(wb3)
+    assert kv3.get_value(b"nope") == b"x"
+    kv3.close()
+
+
+# ------------------------------------------------------------- clock plane
+def test_clock_plane_skew_drift_jump_math():
+    """ClockPlane.now continuity rules: mutations re-anchor first (no
+    retroactive jumps), clear() heals the RATE but keeps the accrued
+    offset (heal without a jump), reset() drops state (and IS a jump)."""
+    from dragonboat_tpu.faults import ClockPlane, FaultPlane as FP
+
+    cp = ClockPlane(FP(1))
+    h = "h1"
+    t0 = cp.now(h)
+    assert abs(t0 - time.monotonic()) < 0.05  # default: real monotonic
+    cp.step_jump(h, 2.0)
+    assert cp.now(h) - time.monotonic() > 1.9
+    cp.set_drift(h, 3.0)  # 3x fast from NOW (offset preserved)
+    base = cp.now(h)
+    time.sleep(0.05)
+    faulted = cp.now(h) - base
+    assert faulted > 0.12  # ~3x of >=0.05 real elapsed
+    cp.clear(h)  # rate back to 1.0, offset KEPT
+    still_ahead = cp.now(h) - time.monotonic()
+    assert still_ahead > 1.9
+    before = cp.now(h)
+    time.sleep(0.02)
+    assert 0.015 < cp.now(h) - before < 0.2  # 1x rate again
+    cp.reset(h)  # drop state: back to real time = a backward jump
+    assert abs(cp.now(h) - time.monotonic()) < 0.05
+
+
+@pytest.mark.chaos
+def test_clock_plane_chaos_schedule_replays_bit_identical():
+    """The ClockPlane rides its owning FaultPlane's decision streams:
+    two same-seeded planes draw the IDENTICAL chaos schedule (the
+    crash_restart_schedule replay contract, extended to clocks)."""
+    from dragonboat_tpu.faults import ClockPlane, FaultPlane as FP
+
+    def draw(seed):
+        fp = FP(seed)
+        cp = ClockPlane(fp)
+        gen = cp.chaos_schedule("longhaul", ["h1", "h2", "h3"], total_s=3.0)
+        return [ev for ev in gen]
+
+    a, b = draw(0x77), draw(0x77)
+    assert a == b and len(a) > 0
+    kinds = {ev[1] for ev in a}
+    assert kinds <= {"skew", "drift", "jump"}
+    c = draw(0x78)
+    assert c != a  # a different seed draws a different schedule

@@ -196,3 +196,40 @@ def test_longhaul_same_seed_round_signature_is_bit_identical(tmp_path):
         runs.append(report["rounds"][0])
     assert runs[0].signature == runs[1].signature
     assert runs[0].scenarios == runs[1].scenarios
+
+
+@pytest.mark.chaos
+def test_longhaul_lease_clock_chaos_round_replays_bit_identical(tmp_path):
+    """ISSUE 17: the `lease_clock_chaos` scenario (seeded skew/drift/
+    jump windows on live hosts' tick clocks while lease-read traffic
+    runs) passes its verdicts in a single seeded round, and the SAME
+    seed replays to the SAME orchestration-schedule signature — clock
+    faults ride the FaultPlane decision streams like crashes do."""
+    runs = []
+    for i in (1, 2):
+        report = run_longhaul(
+            Options(
+                budget_s=30.0,
+                rounds_max=1,
+                round_s=4.0,
+                engine="scalar",
+                out_dir=str(tmp_path / f"run{i}"),
+                seed=0x2B1,
+                ring=False,
+                scenarios=("lease_clock_chaos",),
+            )
+        )
+        assert report["ok"], [r.verdicts for r in report["rounds"]]
+        r = report["rounds"][0]
+        assert r.verdicts["lincheck"]
+        assert r.verdicts["fairness_no_stall"]
+        # the lease verdicts are present whenever fault windows ran, and
+        # a round that injected skew past the margin must show FALLBACK
+        # (reads served via ReadIndex), never a lincheck violation
+        if "lease_reads_linearizable" in r.verdicts:
+            assert r.verdicts["lease_reads_linearizable"]
+        if "lease_fallback_served" in r.verdicts:
+            assert r.verdicts["lease_fallback_served"]
+        runs.append(r)
+    assert runs[0].signature == runs[1].signature
+    assert runs[0].scenarios == runs[1].scenarios

@@ -170,6 +170,9 @@ def _ref_route(s, o, route, rdelta, cfg):
                 continue
             if stage(
                 d, MSG.HEARTBEAT, int(self_slot[g]), int(term[g]),
+                # the lease round tag rides log_index UNTRANSLATED (an
+                # opaque tick stamp, not an index — no rdelta)
+                log_index=int(o["lease_round"][g]),
                 commit=max(
                     int(o["send_hb_commit"][g, p]) + int(rdelta[g, p]), 0
                 ),
@@ -214,6 +217,8 @@ def _ref_route(s, o, route, rdelta, cfg):
             elif t == MSG.HEARTBEAT_RESP:
                 ok = stage(
                     d, t, int(self_slot[g]), int(o["resp_term"][g, k]),
+                    # echoes the lease round tag untranslated (no delta)
+                    log_index=int(o["resp_log_index"][g, k]),
                     hint=int(o["resp_hint"][g, k]),
                     hint_high=int(o["resp_hint2"][g, k]),
                 )
@@ -316,6 +321,8 @@ def _random_state_and_output(rng):
         ready_ctx=ready_ctx,
         ready_ctx2=_rng_i32(rng, (G, R), 0, 1 << 20),
         ready_index=_rng_i32(rng, (G, R), 0, W - 2),
+        # opaque lease round tag: rides heartbeat log_index untranslated
+        lease_round=_rng_i32(rng, (G,), 0, 1 << 16),
     )
     for f in StepOutput._fields:
         if z[f] is None and f not in o:
@@ -339,6 +346,10 @@ def _random_state_and_output(rng):
                 "match": (KCFG.groups, P), "rstate": (KCFG.groups, P),
                 "last_index": (KCFG.groups,),
                 "quiesced": (KCFG.groups,),
+                "lease_round": (KCFG.groups,),
+                "lease_ok": (KCFG.groups,),
+                "lease_served": (KCFG.groups,),
+                "lease_fallback": (KCFG.groups,),
             }[f]
             o[f] = np.zeros(shape, np.int32)
     out = StepOutput(**{f: jnp.asarray(o[f]) for f in StepOutput._fields})

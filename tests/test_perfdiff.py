@@ -219,6 +219,40 @@ def test_refuses_mesh_shape_mismatch():
     assert compare_config(a, a1)["verdict"] == PASS
 
 
+def test_refuses_lease_vs_readindex_reads():
+    """The read-mode honesty rule (ISSUE 17, same shape as the K /
+    workload / mesh refusals): a lease-read run serves reads locally at
+    the leader while a ReadIndex run pays a quorum confirmation per
+    read batch — diffing them would read the lease win as a ReadIndex
+    regression (or vice versa). Golden-fixture CLI check plus both API
+    directions; a missing stamp means ReadIndex (every pre-lease record
+    keeps comparing)."""
+    lease = os.path.join(_DATA, "perfdiff_lease.json")
+    for extra in ((), ("--gate",)):
+        p = _cli(BASE, lease, *extra)
+        assert p.returncode == 2, p.stdout + p.stderr
+        assert "INCOMPARABLE" in p.stdout
+        assert "read_mode" in p.stdout
+    a = load_record(BASE)["configs"]["1"]
+    b = load_record(lease)["configs"]["1"]
+    r = compare_config(a, b)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("read_mode" in s for s in r["reasons"])
+    # and in reverse (new side predates the stamp -> implicit readindex)
+    r = compare_config(b, a)
+    assert r["verdict"] == INCOMPARABLE
+    assert any("read_mode" in s for s in r["reasons"])
+    # lease-vs-lease compares normally: the lease trajectory gates
+    # against its own baseline without refusal
+    b2 = json.loads(json.dumps(b))
+    assert compare_config(b, b2)["verdict"] == PASS
+    # a legacy record with no stamp is a ReadIndex run by construction,
+    # comparable with a modern explicit readindex stamp
+    a1 = json.loads(json.dumps(a))
+    a1["read_mode"] = "readindex"
+    assert compare_config(a, a1)["verdict"] == PASS
+
+
 def test_same_steps_per_sync_stays_comparable():
     """Two runs at the SAME K>1 diff normally (the K=8 trajectory can
     gate against itself), and a missing stamp means the classic K=1
