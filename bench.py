@@ -385,6 +385,7 @@ def _bench_e2e_body(
         err.update(_mesh_report(hosts, shard_over_mesh))
         err.update(_attribution_report(hosts, None, None))
         err.update(_read_report(hosts, 0, 0.0, read_mode))
+        err.update(_census_report(hosts))
         return err
     if drop_rate > 0 and shared:
         # randomized replication drops over the co-hosted path (the wire
@@ -433,6 +434,7 @@ def _bench_e2e_body(
         out.update(_lane_report(hosts))
         out.update(_serving_report(hosts))
         out.update(_read_report(hosts, 0, out["seconds"], read_mode))
+        out.update(_census_report(hosts))
         return out
     sessions = {
         c: hosts[leaders[c]].get_noop_session(c) for c in range(1, groups + 1)
@@ -568,6 +570,7 @@ def _bench_e2e_body(
     out.update(_lane_report(hosts))
     out.update(_serving_report(hosts))
     out.update(_read_report(hosts, reads_done, dt, read_mode))
+    out.update(_census_report(hosts))
     return out
 
 
@@ -598,6 +601,42 @@ def _read_report(hosts, reads_done: int, dt: float, read_mode: str) -> dict:
         "lease_reads_local": local,
         "lease_reads_fallback": fallback,
     }
+
+
+def _census_report(hosts) -> dict:
+    """HBM census + protocol-event counter fold, ALWAYS present in every
+    config JSON — zero-filled when no engine reports (including the
+    bring-up-failed path) so the schema stays stable for tools.perfdiff
+    and the paged-arena ROADMAP item reads its sizing baseline straight
+    off any bench artifact. Distinct engines only (same dedupe as
+    _read_report); bytes sum across engines, fill/waste take the worst
+    engine (percentiles don't sum)."""
+    from dragonboat_tpu.ops.state import CTR_NAMES
+    from dragonboat_tpu.profile import CENSUS_KEYS, DeviceCensus
+
+    seen = {}
+    for nh in hosts.values():
+        eng = getattr(nh, "engine", None)
+        if getattr(eng, "device_census", None) is not None:
+            seen[id(getattr(eng, "core", eng))] = eng
+    out = {k: DeviceCensus.empty()[k] for k in CENSUS_KEYS}
+    counters = {name: 0 for name in CTR_NAMES}
+    for eng in seen.values():
+        try:
+            c = eng.device_census()
+        except Exception:
+            continue
+        out["hbm_bytes_total"] += int(c["hbm_bytes_total"])
+        out["hbm_log_bytes"] += int(c["hbm_log_bytes"])
+        for k in ("log_fill_p50", "log_fill_p99", "hbm_waste_ratio"):
+            out[k] = max(out[k], float(c[k]))
+        fn = getattr(eng, "counter_stats", None)
+        if fn is not None:
+            for name, v in fn().items():
+                if name in counters:
+                    counters[name] += int(v)
+    out["counters"] = counters
+    return out
 
 
 def _front_measure(

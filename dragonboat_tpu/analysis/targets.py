@@ -320,6 +320,11 @@ def _default_targets() -> Targets:
             "CompileWatch", "_mu", 60,
             "compile-event counters + registered-function table (leaf)",
         ),
+        LockSpec(
+            "DeviceCensus", "_mu", 60,
+            "HBM census plane table (leaf: written once at engine init, "
+            "read by the 1/s export paths)",
+        ),
     ]
     guarded_state = {
         TRANSPORT: {
@@ -357,6 +362,7 @@ def _default_targets() -> Targets:
             "PhasePlane": {"_hists": "_mu"},
             "SyncAudit": {"_out": "_mu"},
             "CompileWatch": {"_fns": "_mu"},
+            "DeviceCensus": {"_planes": "_mu"},
         },
         MANAGED: {
             "ManagedStateMachine": {"_destroyed": "_mu"},

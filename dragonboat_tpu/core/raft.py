@@ -100,6 +100,22 @@ class Raft:
         self.clock_suspect_until = 0  # no grants/serves before this tick
         self.lease_served = 0  # reads served locally off the lease
         self.lease_fallback = 0  # lease-mode reads that fell back to quorum
+        # protocol-event counters, the scalar twin of the kernel's
+        # on-device counter plane (ops/state.CTR): incremented at the
+        # point the event fires — a campaign launched, a leadership won,
+        # a heartbeat message handed to the outbox per target, a
+        # Replicate answered with reject — so the vector kernel's
+        # per-lane counters and these stay differential-comparable.
+        # Plain int reads on export paths (ExecEngine.counter_stats);
+        # commit_advances is DERIVED (log.committed - _commit_origin,
+        # index units) because committed moves at several sites but the
+        # units advanced are what the kernel counts.
+        self.elections_started = 0
+        self.elections_won = 0
+        self.heartbeats_sent = 0
+        self.replicate_rejects = 0
+        self.read_confirmations = 0
+        self._commit_origin = 0
         self.tick_count = 0
         self.election_tick = 0
         self.heartbeat_tick = 0
@@ -127,6 +143,8 @@ class Raft:
             self.witnesses[p] = Remote(next=1)
         if not st.is_empty():
             self._load_state(st)
+        # recovered commit progress is not an "advance" this core made
+        self._commit_origin = self.log.committed
         if cfg.is_observer:
             self.state = RaftNodeState.OBSERVER
             self.become_observer(self.term, NO_LEADER)
@@ -137,6 +155,13 @@ class Raft:
             self.become_follower(self.term, NO_LEADER)
 
     # ------------------------------------------------------------------ util
+    @property
+    def commit_advances(self) -> int:
+        # index units advanced since this core instantiated (kernel commits
+        # once per step at the quorum fold, the scalar core per message —
+        # events diverge but index units stay lockstep-identical)
+        return self.log.committed - self._commit_origin
+
     def is_leader(self) -> bool:
         return self.state == RaftNodeState.LEADER
 
@@ -402,9 +427,13 @@ class Raft:
             tag = self.lease_round_tick
         for nid, rm in self.voting_members().items():
             if nid != self.node_id:
+                # counted per target at the send decision (the kernel's
+                # counter increments at its broadcast sites the same way)
+                self.heartbeats_sent += 1
                 self.send_heartbeat_message(nid, ctx, rm.match, tag)
         if ctx.is_zero():
             for nid, rm in self.observers.items():
+                self.heartbeats_sent += 1
                 self.send_heartbeat_message(nid, ctx, rm.match, tag)
 
     def send_timeout_now_message(self, node_id: int) -> None:
@@ -503,6 +532,7 @@ class Raft:
     def become_leader(self) -> None:
         if not (self.is_leader() or self.is_candidate()):
             raise RuntimeError(f"transitioning to leader from {self.state}")
+        self.elections_won += 1
         self.state = RaftNodeState.LEADER
         self._reset(self.term)
         self.set_leader_id(self.node_id)
@@ -559,6 +589,9 @@ class Raft:
             )
 
     def campaign(self) -> None:
+        # a REAL campaign (term bump + vote solicitation); pre-vote polls
+        # are not counted — same rule as the kernel's _campaign counter
+        self.elections_started += 1
         self.become_candidate()
         term = self.term
         if self.events is not None:
@@ -898,6 +931,11 @@ class Raft:
         return last_committed_term == self.term
 
     def _add_ready_to_read(self, index: int, ctx: SystemCtx) -> None:
+        # one confirmed linearizable read point handed to the engine —
+        # lease serves, single-node instant reads, leader quorum
+        # confirmations and forwarded-read responses all land here, which
+        # is exactly what the kernel's ready-queue pop counter tallies
+        self.read_confirmations += 1
         self.ready_to_read.append(ReadyToRead(index=index, system_ctx=ctx))
 
     def lease_valid(self) -> bool:
@@ -1199,6 +1237,7 @@ class Raft:
             resp.log_index = last_idx
         else:
             resp.reject = True
+            self.replicate_rejects += 1
             resp.log_index = m.log_index
             resp.hint = self.log.last_index()
             if self.events is not None:

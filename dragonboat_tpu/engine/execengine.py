@@ -18,7 +18,7 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..logger import get_logger
-from ..profile import phase_plane
+from ..profile import DeviceCensus, phase_plane
 from ..settings import hard, soft
 from ..trace import LatencySampler, Profiler
 from ..types import Update
@@ -27,6 +27,20 @@ from .fairness import FairnessWatchdog
 from .node import Node
 
 _plog = get_logger("execengine")
+
+# Scalar twin of the kernel's counter plane. Mirrors ops.state.CTR_NAMES
+# verbatim (pinned by a test) — duplicated here so the scalar engine stays
+# importable without jax, which ops.state pulls in at module level.
+_COUNTER_ATTRS = (
+    "elections_started",
+    "elections_won",
+    "heartbeats_sent",
+    "replicate_rejects",
+    "commit_advances",
+    "lease_served",
+    "lease_fallback",
+    "read_confirmations",
+)
 
 
 class _NullProfiler:
@@ -382,9 +396,12 @@ class ExecEngine:
         """Serving-front backpressure probe, shape-compatible with
         VectorEngine.pressure_stats(): worst incoming-queue fill across
         this engine's groups (the EntryQueue/ReadIndexQueue whose
-        overflow IS the ErrSystemBusy raise site one add() later). The
-        scalar engine has no staged-row plane, so backlog is always 0."""
+        overflow IS the ErrSystemBusy raise site one add() later).
+        staged_backlog is the total count of accepted-but-not-yet-stepped
+        requests across those queues — the scalar analogue of the vector
+        engine's staged-row backlog."""
         occ = 0.0
+        backlog = 0
         with self._nodes_mu:
             nodes = list(self._nodes.values())
         for node in nodes:
@@ -393,7 +410,50 @@ class ExecEngine:
                 node.incoming_proposals.fill(),
                 node.incoming_reads.fill(),
             )
-        return {"inbox_occupancy": occ, "staged_backlog": 0}
+            backlog += (
+                node.incoming_proposals.pending_count()
+                + node.incoming_reads.pending_count()
+            )
+        return {"inbox_occupancy": occ, "staged_backlog": backlog}
+
+    def counter_stats(self) -> Dict[str, int]:
+        """Engine-wide protocol-event counter totals, shape-compatible
+        with VectorEngine.counter_stats() (names = ops.state.CTR_NAMES).
+        Summed from each group's scalar core; plain-int reads off the
+        cores (same torn-read contract as lease_stats)."""
+        totals = {name: 0 for name in _COUNTER_ATTRS}
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            r = getattr(node.peer, "raft", None)
+            if r is None:
+                continue
+            for name in _COUNTER_ATTRS:
+                totals[name] += int(getattr(r, name, 0))
+        return totals
+
+    def lane_counters(self) -> Dict[int, Dict[str, int]]:
+        """Per-group counter rows, cluster_id-keyed — the scalar side of
+        VectorEngineHandle.lane_counters() for tools.top."""
+        out: Dict[int, Dict[str, int]] = {}
+        with self._nodes_mu:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            if node.stopped:
+                continue
+            r = getattr(node.peer, "raft", None)
+            if r is None:
+                continue
+            out[node.cluster_id] = {
+                name: int(getattr(r, name, 0)) for name in _COUNTER_ATTRS
+            }
+        return out
+
+    def device_census(self) -> dict:
+        """Shape-compatible HBM census: the scalar engine holds no device
+        memory, so every byte/fill key is present and zero — consumers
+        (bench JSON, gauges, tools.top) need not branch per engine."""
+        return DeviceCensus.empty()
 
     def lane_stats(self) -> Dict[int, dict]:
         """Per-group introspection, shape-compatible with

@@ -16,6 +16,7 @@ from typing import Callable, List, Optional
 from ..client import Session
 from ..config import Config
 from ..core.peer import Peer, PeerAddress, encode_config_change
+from ..core.remote import RemoteState
 from ..core.logentry import ErrCompacted
 from ..requests import (
     BATCH_KEY_BIT,
@@ -136,6 +137,9 @@ class Node:
         # (parity with the vector engine's _m_leader_change_tick mirror)
         self._leader_change_tick = 0
         self._rate_limited = False  # refreshed each step (cf. node.go:1095)
+        # ticks each peer has spent parked in RemoteState.SNAPSHOT, for
+        # the delayed snapshot-status retry (_snapshot_feedback)
+        self._snap_parked: dict = {}
         # aborted inbound snapshot-install stream window: while fresh, ops
         # that gate on the install fail FAST with the typed
         # ErrSnapshotStreamAborted instead of a generic timeout. Plain
@@ -712,6 +716,48 @@ class Node:
             self.peer.quiesced_tick()
         else:
             self.peer.tick()
+        self._snapshot_feedback()
+
+    def _snapshot_feedback(self) -> None:
+        """Scalar twin of the vector engine's _run_snapshot_feedback (and
+        dragonboat's snapshotstatus push delay): a streamed install whose
+        receiver dies after the chunks leave the sender produces neither a
+        transport failure nor a SNAPSHOT_RECEIVED ack, so the leader's
+        remote would sit in RemoteState.SNAPSHOT forever — is_paused()
+        blocks replication and no heartbeat response can move it. Count
+        how long each remote has been parked in SNAPSHOT; past the retry
+        window, feed the core a synthetic rejected SNAPSHOT_STATUS so the
+        remote un-parks (-> WAIT) and normal probing resumes."""
+        r = getattr(self.peer, "raft", None)
+        if r is None or not r.is_leader():
+            if self._snap_parked:
+                self._snap_parked.clear()
+            return
+        retry_ticks = max(4 * self.config.election_rtt, 16)
+        parked = self._snap_parked
+        seen = []
+        for group in (r.remotes, r.observers, r.witnesses):
+            for nid, rm in group.items():
+                if rm.state != RemoteState.SNAPSHOT:
+                    continue
+                held = parked.get(nid, 0) + 1
+                if held > retry_ticks:
+                    parked.pop(nid, None)
+                    self.mq.add(
+                        Message(
+                            type=MessageType.SNAPSHOT_STATUS,
+                            cluster_id=self.cluster_id,
+                            from_=nid,
+                            reject=True,
+                        )
+                    )
+                    self.engine.set_node_ready(self.cluster_id)
+                else:
+                    parked[nid] = held
+                    seen.append(nid)
+        for nid in list(parked):
+            if nid not in seen:
+                del parked[nid]
 
     # ----------------------------------------------- engine: update processing
     def process_dropped(self, ud: Update) -> None:

@@ -52,6 +52,11 @@ class EntryQueue:
             (len(self._left) + len(self._right)) / self._size, 1.0
         )
 
+    def pending_count(self) -> int:
+        """Lock-free queued-item count (see fill for the torn-read
+        contract) — feeds pressure_stats' staged_backlog."""
+        return len(self._left) + len(self._right)
+
     def add_many(self, entries: List[Entry]) -> int:
         """Enqueue a batch under ONE lock acquisition; returns how many
         were accepted (the tail past capacity is refused and the queue
@@ -117,6 +122,10 @@ class ReadIndexQueue:
     def fill(self) -> float:
         """Lock-free fill fraction in [0, 1] (see EntryQueue.fill)."""
         return min(len(self._pending) / self._size, 1.0)
+
+    def pending_count(self) -> int:
+        """Lock-free queued-request count (see EntryQueue.pending_count)."""
+        return len(self._pending)
 
     def close(self) -> None:
         with self._mu:

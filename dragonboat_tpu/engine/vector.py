@@ -90,6 +90,8 @@ from ..ops.kernel import (
     make_step_fn,
 )
 from ..ops.state import (
+    CTR,
+    CTR_NAMES,
     MSG,
     NEED_SNAPSHOT,
     ROLE,
@@ -107,6 +109,7 @@ from ..ops.state import (
     rebase,
 )
 from ..profile import (
+    DeviceCensus,
     compile_watch,
     note_engine_steps,
     note_seam_sync,
@@ -1253,6 +1256,31 @@ class VectorEngine:
         self._snap_status_mu = threading.Lock()
         self._alloc_buffers()
         self._alloc_mirrors()
+        # HBM census (profile.DeviceCensus): plane bytes are STATIC
+        # tensor metadata (shapes never change over the engine's life),
+        # reported once here from `.nbytes` — device_census() later folds
+        # the logical log fill from the decode-maintained mirrors, so
+        # reading the census costs zero device syncs at any point
+        self.census = DeviceCensus()
+        planes = {
+            f"state.{name}": int(arr.nbytes)
+            for name, arr in self._state._asdict().items()
+        }
+        if self._multi > 1:
+            for name, arr in self._resid._asdict().items():
+                planes[f"resid.{name}"] = int(arr.nbytes)
+        staging = sum(
+            int(plane.nbytes)
+            for buf, ticks, _inbox in self._bufsets
+            for plane in list(buf.values()) + [ticks]
+        )
+        self.census.set_planes(
+            planes,
+            log_planes=("state.log_term", "state.log_is_cc"),
+            devices=max(1, self._mesh_devices),
+            log_window=self.kcfg.log_window,
+            host_staging_bytes=staging,
+        )
         # worker pools for apply + snapshot work (same split as ExecEngine)
         self._n_task = num_task_workers or min(
             soft.step_engine_task_worker_count, 4
@@ -1362,6 +1390,12 @@ class VectorEngine:
         # device syncs — updated only for lanes the decode phase already
         # iterates as changed
         self._m_leader_change_tick = np.zeros(G, np.int64)
+        # cumulative per-lane protocol-event counters: the kernel's
+        # per-step u32 deltas (StepOutput.counters, one CTR.* column per
+        # event) summed here by the decode fold — loop-thread writes,
+        # lock-free reads via counter_stats/lane_counters (a torn read
+        # costs one stale sample on an export path, never a decision)
+        self._ctr = np.zeros((G, CTR.COUNT), np.uint64)
 
     # ------------------------------------------------------- mirror helpers
     def _committed_real(self, g: int) -> int:
@@ -2615,6 +2649,13 @@ class VectorEngine:
         # already fetched — zero extra device syncs)
         self._lease_local += int(o["lease_served"].sum())
         self._lease_fb += int(o["lease_fallback"].sum())
+        # on-device event-counter plane: one (G, CTR.COUNT) u32 delta
+        # block per protocol step, accumulated where the events happened
+        # (inside step_batch / the K-step scan) and folded here into the
+        # cumulative per-lane totals — the K>1 / device-routed regime
+        # counts exactly like K=1 because the kernel counted it, not the
+        # host decode
+        self._ctr += o["counters"]
         self._m_lease_ok = np.asarray(o["lease_ok"])
         # ---- phase 0: place payloads at device-assigned indexes ----------
         # columnar: ONE gather per StepOutput plane over every packed row,
@@ -3569,6 +3610,7 @@ class VectorEngine:
         self._m_active[g] = True
         self._m_snap_every[g] = cfg.snapshot_entries
         self._m_applied_since[g] = 0
+        self._ctr[g] = 0  # a reused lane must not inherit event counters
         self._m_snap_pending[g] = False
         self._m_quiesced[g] = False  # a reused lane must not inherit this
         self._m_leader_change_tick[g] = self.clock.tick
@@ -3721,6 +3763,7 @@ class VectorEngine:
         self._m_quiesced[g] = False
         self._m_host[g] = 0
         self._m_leader_change_tick[g] = 0
+        self._ctr[g] = 0
         self._carry.discard(lane)
         self._catchups.discard(lane)
         self._snapfb.discard(lane)
@@ -4055,6 +4098,46 @@ class VectorEngine:
         suspect). Plain int reads of decode-maintained counters."""
         return {"local": self._lease_local, "fallback": self._lease_fb}
 
+    def counter_stats(self) -> Dict[str, int]:
+        """Cumulative protocol-event totals across all lanes, keyed by
+        the canonical CTR_NAMES vocabulary (elections started/won,
+        heartbeats sent, replicate rejects, commit advances IN INDEX
+        UNITS, lease served/fallback, read confirmations). The deltas
+        were counted ON DEVICE inside step_batch — including K>1 inner
+        steps and device-routed co-hosted traffic — and folded by the
+        decode phase; reading them is a plain numpy sum over the
+        cumulative mirror, zero device syncs."""
+        totals = self._ctr.sum(axis=0)
+        return {name: int(totals[i]) for i, name in enumerate(CTR_NAMES)}
+
+    def lane_counters(self) -> Dict[tuple, Dict[str, int]]:
+        """Per-lane cumulative event counters (lane key -> CTR_NAMES
+        dict), same sourcing as counter_stats. Joined with lane_stats on
+        the lane key by tools.top's heat ranking."""
+        out: Dict[tuple, Dict[str, int]] = {}
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+        ctr = self._ctr
+        for lane in lanes:
+            if not lane.active:
+                continue
+            row = ctr[lane.g]
+            out[lane.key] = {
+                name: int(row[i]) for i, name in enumerate(CTR_NAMES)
+            }
+        return out
+
+    def device_census(self) -> dict:
+        """HBM census snapshot: static plane bytes (reported once at
+        allocation) + per-lane logical log fill folded from the decode-
+        maintained mirrors — zero device syncs, like lane_stats. The
+        ROADMAP paged-arena item's measured baseline."""
+        return self.census.snapshot(
+            last=self._m_last,
+            devfirst=self._m_devfirst,
+            active=self._m_active,
+        )
+
     def pressure_stats(self) -> dict:
         """Serving-front backpressure probe (serving.backpressure.
         SaturationMonitor): inbox-row occupancy of the last packed step
@@ -4262,6 +4345,15 @@ class VectorEngineHandle:
         return {
             key[1]: v
             for key, v in self.core.lane_stats().items()
+            if key[0] == self.host
+        }
+
+    def lane_counters(self) -> Dict[int, Dict[str, int]]:
+        """cluster_id -> cumulative event counters for this host's
+        lanes (see VectorEngine.lane_counters)."""
+        return {
+            key[1]: v
+            for key, v in self.core.lane_counters().items()
             if key[0] == self.host
         }
 

@@ -1511,6 +1511,30 @@ class NodeHost(IMessageHandler):
             "engine_compile_events_total", (0, 0),
             float(compile_watch().total),
         )
+        # HBM census: device-plane bytes + per-lane log fill vs the dense
+        # widest-lane allocation (VectorEngine folds from its numpy
+        # mirrors, the scalar engine reports an all-zero shape twin) —
+        # the paged-arena sizing baseline on the live dashboard
+        census = getattr(self.engine, "device_census", None)
+        if census is not None:
+            c = census()
+            for gname, ckey in (
+                ("engine_hbm_bytes_total", "hbm_bytes_total"),
+                ("engine_hbm_log_bytes", "hbm_log_bytes"),
+                ("engine_hbm_log_fill_p50", "log_fill_p50"),
+                ("engine_hbm_log_fill_p99", "log_fill_p99"),
+                ("engine_hbm_waste_ratio", "hbm_waste_ratio"),
+            ):
+                self.metrics.set_gauge(gname, (0, 0), float(c[ckey]))
+        # protocol-event counter plane (ops/state.CTR): accumulated
+        # on-device inside step_batch, decoded through the blessed fetch
+        # seam — exporting is a numpy fold, never a device sync
+        counter_stats = getattr(self.engine, "counter_stats", None)
+        if counter_stats is not None:
+            for name, v in counter_stats().items():
+                self.metrics.set_gauge(
+                    f"engine_counter_{name}", (0, 0), float(v)
+                )
         # per-lane (cluster_id-labelled) introspection from the engine's
         # numpy mirrors: leader, term, commit gap, ticks since the last
         # leader change — zero device syncs (see VectorEngine.lane_stats)

@@ -252,6 +252,15 @@ def _campaign(
     single = real & (_num_voting(ns) == 1)
     noop_at = jnp.where(single, ns.last_index + 1, 0)
     ns = _become_leader(ns, single)
+    # counter plane: a real campaign is an election started (pre-vote
+    # polls are not — the scalar core's campaign() vs pre_campaign()
+    # split), and the single-voter instant win is an election won
+    out["ctr_elections_started"] = out["ctr_elections_started"] + jnp.where(
+        real, 1, 0
+    )
+    out["ctr_elections_won"] = out["ctr_elections_won"] + jnp.where(
+        single, 1, 0
+    )
     # vote/pre-vote requests to all other voting members (one shared
     # descriptor plane: the wire type and term are selected downstream
     # from the end-of-step role — a lane is never both roles at once)
@@ -412,6 +421,7 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     lose = rvr & ~win & (rejected >= q)
     noop_at = jnp.where(win, s.last_index + 1, 0)
     s = _become_leader(s, win)
+    out["ctr_elections_won"] = out["ctr_elections_won"] + jnp.where(win, 1, 0)
     out["noop_appended"] = jnp.maximum(out["noop_appended"], noop_at)
     out["noop_term"] = jnp.maximum(out["noop_term"], jnp.where(win, s.term, 0))
     s = _become_follower(s, lose, s.term, jnp.zeros_like(s.leader))
@@ -469,6 +479,9 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     in_window = (prev >= s.first_index - 1) & (prev <= s.last_index)
     ok = rep & ~stale & match_prev & in_window
     rej = rep & ~stale & ~ok
+    out["ctr_replicate_rejects"] = out["ctr_replicate_rejects"] + jnp.where(
+        rej, 1, 0
+    )
     # conflict scan over the E attached entries
     if E > 0:
         e_idx = prev[:, None] + 1 + jnp.arange(E, dtype=i32)[None, :]
@@ -688,6 +701,11 @@ def _handle_message(s: RaftTensors, m, out, cfg: KernelConfig):
     out["send_flags"] = jnp.where(
         enq[:, None] & others_v, out["send_flags"] | SEND_HEARTBEAT, out["send_flags"]
     )
+    # counted at the send decision (the scalar core's per-target
+    # broadcast_heartbeat_message(ctx)), not at end-of-step gating
+    out["ctr_heartbeats_sent"] = out["ctr_heartbeats_sent"] + jnp.sum(
+        enq[:, None] & others_v, axis=1
+    ).astype(i32)
     out["send_hint"] = jnp.where(
         enq[:, None] & others_v, m["hint"][:, None], out["send_hint"]
     )
@@ -926,6 +944,9 @@ def _tick(s: RaftTensors, ticks, out):
     out["send_flags"] = jnp.where(
         hb_due[:, None] & tgt, out["send_flags"] | SEND_HEARTBEAT, out["send_flags"]
     )
+    out["ctr_heartbeats_sent"] = out["ctr_heartbeats_sent"] + jnp.sum(
+        hb_due[:, None] & tgt, axis=1
+    ).astype(i32)
     out["send_hint"] = jnp.where(hb_due[:, None] & tgt, hint[:, None], out["send_hint"])
     out["send_hint2"] = jnp.where(
         hb_due[:, None] & tgt, hint2[:, None], out["send_hint2"]
@@ -964,6 +985,13 @@ def step_batch(
         "fwd_leader": jnp.zeros((G,), i32),
         "log_full": jnp.zeros((G,), bool),
         "force_probe": jnp.zeros((G, P), bool),
+        # event-counter plane accumulators (CTR slots computed elsewhere:
+        # commit advances from the step-end commit delta, lease counters
+        # shared with the lease plane, read confirmations = ready pops)
+        "ctr_elections_started": jnp.zeros((G,), i32),
+        "ctr_elections_won": jnp.zeros((G,), i32),
+        "ctr_heartbeats_sent": jnp.zeros((G,), i32),
+        "ctr_replicate_rejects": jnp.zeros((G,), i32),
     }
 
     s = _quiesce(s, inbox, ticks)
@@ -1147,6 +1175,24 @@ def step_batch(
 
     last_term_out = _term_at(s, s.last_index)
 
+    # counter plane assembly, one column per CTR slot. Commit advances
+    # are the step-end commit delta (INDEX UNITS — see state.CTR), which
+    # folds the leader quorum fold and every follower commit move into
+    # the one number that is lockstep-comparable to the scalar core.
+    counters = jnp.stack(
+        [
+            out["ctr_elections_started"],
+            out["ctr_elections_won"],
+            out["ctr_heartbeats_sent"],
+            out["ctr_replicate_rejects"],
+            s.committed - prev_commit,
+            out["lease_served"],
+            out["lease_fallback"],
+            ready_count * s.active,
+        ],
+        axis=1,
+    ).astype(jnp.uint32)
+
     # suppress send directives whose issuing role died mid-step: a lane that
     # was leader during the tick phase but stepped down while draining the
     # inbox must not emit leader traffic stamped with its new term (the
@@ -1218,6 +1264,7 @@ def step_batch(
             s.lease_on & s.clock_ok & (s.role == ROLE.LEADER)
             & (s.tick_count < s.lease_until) & (s.transfer_to == 0)
         ),
+        counters=counters,
     )
     return s, output
 

@@ -18,6 +18,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .state import (
+    CTR,
     MSG,
     NEED_SNAPSHOT,
     ROLE,
@@ -111,6 +112,12 @@ class LoopbackCluster:
             [] for _ in range(n_replicas)
         ]
         self.snapshot_requests: List[Tuple[int, int, int]] = []
+        # cumulative event-counter plane per replica, accumulated from
+        # every StepOutput exactly like the engine's decode fold
+        self.counters: List[np.ndarray] = [
+            np.zeros((self.cfg.groups, CTR.COUNT), np.uint64)
+            for _ in range(n_replicas)
+        ]
 
     # ------------------------------------------------------------ injection
     def propose(self, replica: int, group: int, n: int = 1, cc_first: bool = False):
@@ -305,6 +312,7 @@ class LoopbackCluster:
             self.states[h] = st
             outs.append(out)
             self.last_outputs[h] = out
+            self.counters[h] += np.asarray(out.counters, np.uint64)
         for h in range(self.n_replicas):
             self._route(h, outs[h], self.states[h])
 

@@ -78,6 +78,44 @@ SEND_TIMEOUT_NOW = 8
 NEED_SNAPSHOT = 16
 
 
+class CTR:
+    """Slots of the per-lane event-counter plane (StepOutput.counters
+    [:, CTR.*], u32 per-step deltas). Each slot counts the protocol event
+    at the point the SCALAR core would fire it (campaign(), become_leader(),
+    a heartbeat send, ...), so kernel counters are differential-comparable
+    against core.raft event counts — a descriptor suppressed by the
+    end-of-step role gate still counts, exactly like the scalar core's
+    already-sent message does."""
+
+    ELECTIONS_STARTED = 0  # real campaigns (pre-vote polls excluded)
+    ELECTIONS_WON = 1  # become-leader transitions
+    HEARTBEATS_SENT = 2  # per-target heartbeat sends (tick + readindex)
+    REPLICATE_REJECTS = 3  # Replicate messages rejected (log mismatch)
+    # commit advances count INDEX UNITS, not events: the kernel commits
+    # once per step at the quorum fold while the scalar core commits per
+    # message, so event counts differ by construction — units advanced
+    # are identical in lockstep (both end each round at the same commit)
+    COMMIT_ADVANCES = 4  # commit index units advanced (leader + follower)
+    LEASE_SERVED = 5  # reads served locally off a live lease
+    LEASE_FALLBACK = 6  # lease-on reads that fell back to quorum
+    READ_CONFIRMED = 7  # readindex confirmations delivered (ready pops)
+    COUNT = 8
+
+
+#: bench/stats key per CTR slot, in slot order (the one canonical naming
+#: shared by engine counter_stats(), bench JSON, gauges and tools.top)
+CTR_NAMES = (
+    "elections_started",
+    "elections_won",
+    "heartbeats_sent",
+    "replicate_rejects",
+    "commit_advances",
+    "lease_served",
+    "lease_fallback",
+    "read_confirmations",
+)
+
+
 class KernelConfig(NamedTuple):
     """Static shape configuration compiled into the kernel."""
 
@@ -257,6 +295,12 @@ class StepOutput(NamedTuple):
     lease_served: jax.Array  # i32[G] reads served locally off the lease
     lease_fallback: jax.Array  # i32[G] lease-on reads that fell back to quorum
     lease_ok: jax.Array  # bool[G] lane holds a live lease after this step
+    # event-counter plane: per-step u32 deltas, one column per CTR slot,
+    # accumulated INSIDE the step (so K inner steps and device-routed
+    # traffic are counted where they happen) and folded host-side into
+    # cumulative per-lane counters at decode. None of these are
+    # index-valued: rebase never touches them.
+    counters: jax.Array  # u32[G, CTR.COUNT]
 
 
 class RoutePlan(NamedTuple):

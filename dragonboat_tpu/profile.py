@@ -300,6 +300,144 @@ def diff_sync(before: dict, after: dict) -> dict:
     }
 
 
+# the HBM census schema: ALWAYS-present bench-JSON / gauge keys (the
+# ROADMAP paged-arena item's baseline). Zero-filled when no device
+# engine ran (bring-up-failed path, scalar-only hosts).
+CENSUS_KEYS = (
+    "hbm_bytes_total",   # device-resident protocol-state bytes (all planes)
+    "hbm_log_bytes",     # the dense per-lane log ring's share of the above
+    "log_fill_p50",      # median per-lane logical fill of the W-slot ring
+    "log_fill_p99",      # tail fill: the widest lane the dense ring is for
+    "hbm_waste_ratio",   # 1 - logical/physical over the whole log plane
+)
+
+
+class DeviceCensus:
+    """HBM census of one engine's device-resident state planes.
+
+    Physical bytes are STATIC tensor metadata: the owning engine reports
+    each plane's ``.nbytes`` (shape x dtype) once at allocation time via
+    ``set_planes`` — shapes never change over an engine's life, so the
+    census never touches the device to answer "how much HBM does the
+    protocol state hold". Logical per-lane log fill is numpy arithmetic
+    over the decode-maintained mirrors the engine passes to
+    ``snapshot()`` (``_m_last`` / ``_m_devfirst`` / ``_m_active``) —
+    also zero device syncs, by the same argument as ``lane_stats``.
+
+    ``hbm_waste_ratio`` is the paged-arena item's headline: the dense
+    ring allocates ``G x W`` slots (every lane pays the widest lane's
+    budget); the ratio is the fraction of those slots holding no live
+    log entry. Fill p50/p99 describe the raggedness a paged relayout
+    would exploit.
+
+    jax-free like the rest of this module: numpy is imported inside
+    ``snapshot()`` only (the callers that pass mirrors already loaded
+    it), so jax-free readers (``tools.perfdiff``) can import the class
+    and its ``empty()`` schema without touching a backend."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._planes: Dict[str, int] = {}
+        self._log_planes: Tuple[str, ...] = ()
+        self._devices = 1
+        self._log_window = 0
+        self._host_staging_bytes = 0
+
+    def set_planes(
+        self,
+        planes: Dict[str, int],
+        log_planes: Tuple[str, ...] = (),
+        devices: int = 1,
+        log_window: int = 0,
+        host_staging_bytes: int = 0,
+    ) -> None:
+        """Report the engine's device planes (plane name -> physical
+        bytes). ``log_planes`` names the subset that is the per-lane log
+        ring; ``host_staging_bytes`` is the host-side numpy staging the
+        inbox pack path owns (reported for completeness, never counted
+        as HBM)."""
+        with self._mu:
+            self._planes = dict(planes)
+            self._log_planes = tuple(log_planes)
+            self._devices = max(1, int(devices))
+            self._log_window = int(log_window)
+            self._host_staging_bytes = int(host_staging_bytes)
+
+    def planes(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._planes)
+
+    @staticmethod
+    def empty() -> dict:
+        """The zero-filled census schema: what a host with no device
+        engine (or a bring-up-failed bench config) reports, so the JSON
+        keys are ALWAYS present."""
+        out = {
+            "hbm_bytes_total": 0,
+            "hbm_log_bytes": 0,
+            "log_fill_p50": 0.0,
+            "log_fill_p99": 0.0,
+            "hbm_waste_ratio": 0.0,
+        }
+        out.update(
+            hbm_bytes_per_device=0,
+            host_staging_bytes=0,
+            lanes_active=0,
+            log_window=0,
+            planes={},
+        )
+        return out
+
+    def snapshot(self, last=None, devfirst=None, active=None) -> dict:
+        """The census: physical bytes from the registered plane table,
+        logical fill from the caller's numpy mirrors (device-unit last
+        index, device-unit first live index, active mask). All three
+        mirrors are optional — a caller with no lanes yet gets the
+        physical half with zeroed fill stats."""
+        import numpy as np
+
+        with self._mu:
+            planes = dict(self._planes)
+            log_planes = self._log_planes
+            devices = self._devices
+            W = self._log_window
+            host_staging = self._host_staging_bytes
+        total = sum(planes.values())
+        log_bytes = sum(planes.get(p, 0) for p in log_planes)
+        out = self.empty()
+        out["hbm_bytes_total"] = int(total)
+        out["hbm_log_bytes"] = int(log_bytes)
+        out["hbm_bytes_per_device"] = int(total // devices)
+        out["host_staging_bytes"] = int(host_staging)
+        out["log_window"] = int(W)
+        out["planes"] = planes
+        if last is None or active is None or W <= 0:
+            return out
+        act = np.asarray(active, bool)
+        n_act = int(act.sum())
+        out["lanes_active"] = n_act
+        lastv = np.asarray(last)
+        first = (
+            np.asarray(devfirst) if devfirst is not None
+            else np.ones_like(lastv)
+        )
+        # logical slots a lane holds in the ring: indexes
+        # [first, last] in device units, clipped to the window
+        fill = np.clip(lastv - first + 1, 0, W)
+        live = fill[act] / float(W) if n_act else np.zeros(0)
+        if n_act:
+            out["log_fill_p50"] = round(float(np.percentile(live, 50)), 6)
+            out["log_fill_p99"] = round(float(np.percentile(live, 99)), 6)
+        # waste over the DENSE allocation: every allocated lane (active
+        # or not) pays W slots — that is exactly the dense-vs-ragged
+        # accounting the paged-arena relayout would change
+        total_slots = lastv.size * W
+        logical = float(fill[act].sum()) if n_act else 0.0
+        if total_slots:
+            out["hbm_waste_ratio"] = round(1.0 - logical / total_slots, 6)
+        return out
+
+
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
@@ -462,7 +600,9 @@ def write_exposition(w, prefix: str = _PREFIX) -> None:
 
 
 __all__ = [
+    "CENSUS_KEYS",
     "CompileWatch",
+    "DeviceCensus",
     "EXEC_PHASES",
     "PhasePlane",
     "SyncAudit",

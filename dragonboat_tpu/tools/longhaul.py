@@ -67,6 +67,7 @@ from ..storage.kv import WalKV
 from ..trace import flight_recorder
 from ..transport.loopback import _Registry, loopback_factory
 from .timeline import merge_dumps, sweep_artifacts
+from .top import collect_snapshot, rank_lanes
 
 CLUSTER = 1
 HOSTS = (1, 2, 3)
@@ -1232,6 +1233,25 @@ class _Round:
         with open(merged_path, "w") as f:
             for e in merged:
                 f.write(json.dumps(e, default=str, sort_keys=True) + "\n")
+        # frozen lane-heat view + HBM census at failure time: the
+        # raft-top snapshot the operator would have been watching, and
+        # the device-memory picture of the very lanes that failed
+        census_path = top_path = None
+        live = {nid: nh for nid, nh in self.hosts.items() if nh is not None}
+        if live:
+            try:
+                snap = collect_snapshot(live)
+                top_path = os.path.join(bundle, "top_snapshot.json")
+                with open(top_path, "w") as f:
+                    json.dump(
+                        {**snap, "lanes": rank_lanes(snap)},
+                        f, indent=2, sort_keys=True,
+                    )
+                census_path = os.path.join(bundle, "device_census.json")
+                with open(census_path, "w") as f:
+                    json.dump(snap["census"], f, indent=2, sort_keys=True)
+            except Exception:
+                census_path = top_path = None  # hosts mid-teardown
         self.result.replay = self._replay_cmd()
         manifest = {
             "round": self.no,
@@ -1245,6 +1265,8 @@ class _Round:
             ),
             "swept_artifacts": swept,
             "merged_events": len(merged),
+            "device_census": census_path,
+            "top_snapshot": top_path,
             "replay": self.result.replay,
         }
         with open(os.path.join(bundle, "manifest.json"), "w") as f:

@@ -26,6 +26,10 @@ What is compared, per config present in BOTH records:
     scatters) is a regression (the runtime twin of the `retrace`
     family); the raw compile `total` is reported but not gated — rare
     maintenance ops may lazily compile once inside any window.
+  * `hbm_*` census keys and the `counters` event totals, when BOTH
+    records carry them — informational deltas only, never gated (more
+    HBM may be the fix, fewer elections may be the workload); legacy
+    records without the keys keep comparing untouched.
 
 Honesty rule: a config stamped `scaled_down` (it ran fewer groups than
 its `nominal_groups` regime) is NOT comparable against a nominal run of
@@ -371,6 +375,27 @@ def compare_config(
                 f"{o} -> {n}"
                 + (f" (functions: {sorted(per)[:3]})" if per else "")
             )
+    # ---- HBM census + counter plane (INFORMATIONAL, never gated) ------
+    # device-memory footprint and protocol-event totals are honest run
+    # descriptors, not perf verdicts: more HBM may be the fix (bigger
+    # log window), fewer elections may be the workload. Deltas surface
+    # for the operator; nothing here ever lands in `reasons`. Records
+    # that predate the census (either side) simply omit the section —
+    # legacy trajectories keep comparing untouched.
+    if all(k in old and k in new for k in ("hbm_bytes_total",
+                                           "hbm_waste_ratio")):
+        hbm: dict = {}
+        for k in ("hbm_bytes_total", "hbm_log_bytes",
+                  "log_fill_p50", "log_fill_p99", "hbm_waste_ratio"):
+            o, n = float(old.get(k, 0)), float(new.get(k, 0))
+            hbm[k] = {"old": o, "new": n, "delta_pct": _pct(o, n)}
+        out["hbm"] = hbm
+    octr, nctr = old.get("counters"), new.get("counters")
+    if isinstance(octr, dict) and isinstance(nctr, dict):
+        out["counters"] = {
+            k: {"old": int(octr[k]), "new": int(nctr[k])}
+            for k in sorted(set(octr) & set(nctr))
+        }
     if reasons:
         out["verdict"] = FAIL
     return out
@@ -454,6 +479,13 @@ def render(report: dict, old_name: str = "old", new_name: str = "new") -> str:
                 f"    phase {name:<10} {p['old']:.4f}s -> {p['new']:.4f}s"
                 + (f" ({d:+.1f}%)" if d is not None else "")
                 + mark
+            )
+        h = c.get("hbm")
+        if h:
+            b, w = h["hbm_bytes_total"], h["hbm_waste_ratio"]
+            lines.append(
+                f"    hbm (info): {b['old']:.0f} -> {b['new']:.0f} bytes,"
+                f" waste {w['old']:.2f} -> {w['new']:.2f}"
             )
         for r in c.get("reasons", []):
             lines.append(f"    ! {r}")

@@ -42,6 +42,7 @@ from dragonboat_tpu.ops.kernel import (
     step_batch,
 )
 from dragonboat_tpu.ops.state import (
+    CTR,
     MSG,
     SEND_HEARTBEAT,
     SEND_REPLICATE,
@@ -350,6 +351,7 @@ def _random_state_and_output(rng):
                 "lease_ok": (KCFG.groups,),
                 "lease_served": (KCFG.groups,),
                 "lease_fallback": (KCFG.groups,),
+                "counters": (KCFG.groups, CTR.COUNT),
             }[f]
             o[f] = np.zeros(shape, np.int32)
     out = StepOutput(**{f: jnp.asarray(o[f]) for f in StepOutput._fields})
@@ -539,6 +541,51 @@ def test_superstep_differential():
     # noop + 2 props + cc + new-term noop + post-change proposal
     assert final["committed"][1] >= 6
     assert final["committed"][3] >= 4  # the never-routed lane progressed too
+
+
+def test_superstep_counters_exact_sum_at_k8():
+    """The counter plane sums EXACTLY across inner steps at K=8: the
+    cumulative fold an engine keeps from one K=8 launch (sum over the
+    stacked (K, G, CTR.COUNT) output, the _decode_super path) equals the
+    fold from 8 sequential one-step launches glued by the reference
+    router — no event lost or double-counted at any launch boundary."""
+    steps = 8
+    G = KCFG.groups
+    s_multi, route, rdelta = _cluster_state()
+    s_seq = jax.tree.map(lambda x: x, s_multi)
+    multi = make_multi_step_fn(KCFG, steps, donate=False)
+    step = make_step_fn(KCFG, donate=False)
+    route_j, rdelta_j = jnp.asarray(route), jnp.asarray(rdelta)
+    ticks = jnp.zeros((G,), jnp.int32)
+    resid_np = _empty_inbox_np(KCFG)
+    resid_multi = make_empty_inbox(KCFG)
+    tot_multi = np.zeros((G, CTR.COUNT), np.uint64)
+    tot_seq = np.zeros((G, CTR.COUNT), np.uint64)
+    for window in range(3):
+        counts = [
+            int((resid_np["mtype"][g] != MSG.NONE).sum()) for g in range(G)
+        ]
+        host = _host_events(window, counts)
+        s_multi, outs, plans, resid_multi, rc = multi(
+            s_multi, _jnp_inbox(host), ticks, resid_multi, route_j, rdelta_j
+        )
+        ctr = np.asarray(jax.device_get(outs.counters))
+        assert ctr.shape == (steps, G, CTR.COUNT)
+        assert ctr.dtype == np.uint32
+        tot_multi += ctr.astype(np.uint64).sum(axis=0)
+        inbox = _merge_inbox(resid_np, host)
+        for _t in range(steps):
+            s_seq, out = step(s_seq, _jnp_inbox(inbox), ticks)
+            o = _np_tree(out)._asdict()
+            tot_seq += o["counters"].astype(np.uint64)
+            inbox, _masks = _ref_route(s_seq, o, route, rdelta, KCFG)
+        resid_np = inbox
+        assert np.array_equal(tot_multi, tot_seq), window
+    # the scenario moved what it claims: window 0 elected lane 0, window
+    # 1 committed proposals, window 2 handed leadership to lane 1
+    assert int(tot_multi[0, CTR.ELECTIONS_WON]) >= 1
+    assert int(tot_multi[1, CTR.ELECTIONS_WON]) >= 1
+    assert int(tot_multi[:, CTR.COMMIT_ADVANCES].sum()) > 0
 
 
 def test_superstep_consumes_residual_without_host_work():

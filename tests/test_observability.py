@@ -477,6 +477,115 @@ def test_scalar_engine_lane_stats_parity(tmp_path):
         nh.stop()
 
 
+def test_census_and_counter_gauges_in_exposition(single_host):
+    """ISSUE 18: the engine_hbm_* census gauges and engine_counter_*
+    event gauges flow through _export_health_gauges into a conformant
+    Prometheus exposition on a live vector host."""
+    nh = single_host
+    sess = nh.get_noop_session(1)
+    for i in range(4):
+        nh.sync_propose(sess, f"k{i}=v".encode(), timeout_s=10.0)
+    nh._export_health_gauges()
+    m = nh.metrics
+    assert m.gauge_value("engine_hbm_bytes_total", (0, 0)) > 0
+    assert m.gauge_value("engine_hbm_log_bytes", (0, 0)) > 0
+    assert m.gauge_value("engine_hbm_log_fill_p50", (0, 0)) > 0.0
+    assert m.gauge_value("engine_hbm_log_fill_p99", (0, 0)) > 0.0
+    waste = m.gauge_value("engine_hbm_waste_ratio", (0, 0))
+    assert 0.0 <= waste < 1.0
+    assert m.gauge_value("engine_counter_elections_won", (0, 0)) >= 1.0
+    assert m.gauge_value("engine_counter_commit_advances", (0, 0)) >= 4.0
+    out = io.StringIO()
+    nh.write_health_metrics(out)
+    text = out.getvalue()
+    assert "dragonboat_tpu_engine_hbm_bytes_total" in text
+    assert "dragonboat_tpu_engine_counter_heartbeats_sent" in text
+    types, samples = _parse_exposition(
+        "\n".join(
+            ln for ln in text.splitlines()
+            if "_hbm_" in ln or "_counter_" in ln
+        )
+    )
+    for name in (
+        "dragonboat_tpu_engine_hbm_waste_ratio",
+        "dragonboat_tpu_engine_counter_elections_started",
+    ):
+        assert types[name] == "gauge"
+
+
+def test_scalar_engine_counter_and_census_parity(tmp_path):
+    """ISSUE 18: ExecEngine exposes the same counter_stats /
+    lane_counters / device_census shapes as the vector engine (names =
+    ops.state.CTR_NAMES; census always-present and all-zero — the
+    scalar engine holds no device memory), so gauges, bench JSON and
+    tools.top need not branch per engine."""
+    import bench
+    from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+    from dragonboat_tpu.engine.execengine import _COUNTER_ATTRS
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.ops.state import CTR_NAMES
+    from dragonboat_tpu.profile import CENSUS_KEYS
+    from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+    from tests.test_nodehost import KVSM
+
+    # the scalar twin's attribute list is pinned to the kernel's order
+    assert _COUNTER_ATTRS == CTR_NAMES
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=1,
+            rtt_millisecond=5,
+            raft_address="sctr1:1",
+            raft_rpc_factory=lambda l: loopback_factory(l, reg),
+            enable_metrics=True,
+            engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "sctr1:1"},
+            False,
+            lambda c, n: KVSM(c, n),
+            Config(cluster_id=1, node_id=1, election_rtt=10, heartbeat_rtt=2),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            lid, ok = nh.get_leader_id(1)
+            if ok and lid == 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("no leader")
+        sess = nh.get_noop_session(1)
+        for i in range(4):
+            nh.sync_propose(sess, f"k{i}=v".encode(), timeout_s=10.0)
+        counters = nh.engine.counter_stats()
+        assert set(counters) == set(CTR_NAMES)
+        assert counters["elections_won"] >= 1
+        assert counters["commit_advances"] >= 4
+        lanes = nh.engine.lane_counters()
+        assert set(lanes) == {1}
+        assert set(lanes[1]) == set(CTR_NAMES)
+        census = nh.engine.device_census()
+        assert set(CENSUS_KEYS) <= set(census)
+        assert census["hbm_bytes_total"] == 0
+        assert census["hbm_waste_ratio"] == 0.0
+        # gauges flow through the same export seam as the vector engine
+        nh._export_health_gauges()
+        assert nh.metrics.gauge_value(
+            "engine_counter_elections_won", (0, 0)
+        ) >= 1.0
+        assert nh.metrics.gauge_value(
+            "engine_hbm_bytes_total", (0, 0)
+        ) == 0.0
+        # and the bench census fold covers the scalar engine too
+        fold = bench._census_report({1: nh})
+        assert fold["hbm_bytes_total"] == 0
+        assert fold["counters"]["commit_advances"] >= 4
+    finally:
+        nh.stop()
+
+
 def test_e2e_unsampled_requests_stay_traceless(tmp_path):
     """profile_sample_ratio=0 -> sparse default (1/32): a couple of
     proposals should mostly carry NO trace object (allocation-free hot

@@ -35,6 +35,7 @@ _DATA = os.path.join(_REPO, "tests", "data")
 BASE = os.path.join(_DATA, "perfdiff_base.json")
 REGRESS = os.path.join(_DATA, "perfdiff_regress.json")
 NOMINAL = os.path.join(_DATA, "perfdiff_nominal.json")
+HBM = os.path.join(_DATA, "perfdiff_hbm.json")
 
 
 def _cli(*args):
@@ -99,6 +100,44 @@ def test_json_report_shape():
 # ---------------------------------------------------------------------------
 # API semantics
 # ---------------------------------------------------------------------------
+
+
+def test_hbm_and_counter_deltas_are_informational():
+    """ISSUE 18: census keys and counter totals surface as deltas but
+    NEVER gate — doubling the waste ratio and 10x-ing every counter
+    still passes, and the render labels the section (info)."""
+    with open(HBM) as f:
+        rec = json.load(f)
+    worse = json.loads(json.dumps(rec))
+    for cfg in worse["configs"].values():
+        cfg["hbm_bytes_total"] *= 2
+        cfg["hbm_waste_ratio"] = min(0.99, cfg["hbm_waste_ratio"] * 1.3)
+        cfg["counters"] = {k: v * 10 for k, v in cfg["counters"].items()}
+    rep = compare(rec, worse)
+    assert rep["verdict"] == PASS
+    c1 = rep["configs"]["1"]
+    assert c1["hbm"]["hbm_bytes_total"]["delta_pct"] == pytest.approx(100.0)
+    assert c1["counters"]["heartbeats_sent"]["new"] == 84000
+    assert not c1["reasons"]
+    assert "hbm (info)" in render(rep)
+    # CLI golden-fixture check: identical hbm-stamped runs gate clean
+    p = _cli(HBM, HBM, "--gate")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "hbm (info)" in p.stdout
+
+
+def test_legacy_records_without_census_keys_keep_comparing():
+    """A legacy record (no hbm_*/counters keys) against an hbm-stamped
+    one compares exactly as before: same verdict, no hbm/counters
+    section, no refusal — the census is an annotation, not a schema
+    break."""
+    rep = compare(load_record(BASE), load_record(HBM))
+    assert rep["verdict"] == PASS
+    for c in rep["configs"].values():
+        assert "hbm" not in c
+        assert "counters" not in c
+    p = _cli(BASE, HBM, "--gate")
+    assert p.returncode == 0, p.stdout + p.stderr
 
 
 def test_phase_regression_rule():

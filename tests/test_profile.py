@@ -293,6 +293,55 @@ def test_vector_scenario_runtime_audit_clean(vec_host):
 
 
 @pytest.mark.perf
+def test_census_and_counters_add_zero_syncs(vec_host):
+    """Acceptance (ISSUE 18): reading the HBM census and the counter
+    plane on a LIVE vector scenario adds ZERO out-of-seam device syncs
+    and zero steady-state retraces — census physical bytes come from
+    init-time tensor metadata, logical fill and counters fold from the
+    decode-maintained numpy mirrors."""
+    nh = vec_host
+    sa = sync_audit().install()
+    cw = compile_watch().install()
+    try:
+        sess = nh.get_noop_session(1)
+        for i in range(4):
+            nh.sync_propose(sess, f"c{i}=v".encode(), timeout_s=10.0)
+        pkg_mark = dict(sa.out_of_seam_in_package())
+        compile_mark = cw.snapshot()
+        census = counters = lanes = None
+        for i in range(4):
+            census = nh.engine.device_census()
+            counters = nh.engine.counter_stats()
+            lanes = nh.engine.lane_counters()
+            nh.sync_propose(sess, f"z{i}=v".encode(), timeout_s=10.0)
+        new_pkg = {
+            s: n for s, n in sa.out_of_seam_in_package().items()
+            if n > pkg_mark.get(s, 0)
+        }
+        assert not new_pkg, f"telemetry read synced the device at {new_pkg}"
+        d = diff_compiles(compile_mark, cw.snapshot())
+        assert d["total"] == 0, f"telemetry read retraced: {d}"
+    finally:
+        sa.uninstall()
+    # the census reports this engine's real planes + this lane's fill
+    assert census["hbm_bytes_total"] > 0
+    assert 0 < census["hbm_log_bytes"] < census["hbm_bytes_total"]
+    assert census["lanes_active"] == 1
+    assert census["log_window"] == 64
+    assert 0.0 < census["log_fill_p50"] <= 1.0
+    assert 0.0 <= census["hbm_waste_ratio"] < 1.0
+    assert "state.log_term" in census["planes"]
+    # the counter plane moved: this lane elected itself and committed
+    from dragonboat_tpu.ops.state import CTR_NAMES
+
+    assert set(counters) == set(CTR_NAMES)
+    assert counters["elections_won"] >= 1
+    assert counters["commit_advances"] >= 8
+    assert set(lanes) == {1}
+    assert lanes[1]["commit_advances"] == counters["commit_advances"]
+
+
+@pytest.mark.perf
 def test_bench_attribution_fold_schema():
     """Acceptance: every bench config JSON always contains
     phase_breakdown (ALL canonical phase keys, zero when the phase never
@@ -306,6 +355,27 @@ def test_bench_attribution_fold_schema():
     assert r["device_syncs"] == {"in_seam": 0, "out_of_seam": 0, "sites": {}}
     assert r["compile_events"]["total"] == 0
     assert r["compile_events"]["per_function"] == {}
+
+
+@pytest.mark.perf
+def test_bench_census_fold_schema():
+    """Acceptance (ISSUE 18): every bench config JSON always carries the
+    HBM census keys and the counter totals — zero-filled on the
+    zero-host / bring-up-failed path, so perfdiff and the paged-arena
+    baseline read a stable schema from any artifact."""
+    import bench
+    from dragonboat_tpu.ops.state import CTR_NAMES
+    from dragonboat_tpu.profile import CENSUS_KEYS
+
+    r = bench._census_report({})
+    assert set(r) == set(CENSUS_KEYS) | {"counters"}
+    assert r["hbm_bytes_total"] == 0
+    assert r["hbm_log_bytes"] == 0
+    assert r["log_fill_p50"] == 0.0
+    assert r["log_fill_p99"] == 0.0
+    assert r["hbm_waste_ratio"] == 0.0
+    assert set(r["counters"]) == set(CTR_NAMES)
+    assert all(v == 0 for v in r["counters"].values())
 
 
 @pytest.mark.perf

@@ -220,6 +220,44 @@ class _FakePressureHost:
         return self.fill
 
 
+def test_scalar_pressure_staged_backlog_counts_queued():
+    """ISSUE 18 satellite: ExecEngine.pressure_stats() must report the
+    REAL accepted-but-not-yet-stepped backlog (EntryQueue + ReadIndex
+    queue pending counts), not a hardcoded 0 — vector-engine parity for
+    the serving front's saturation fold."""
+    from types import SimpleNamespace
+
+    from dragonboat_tpu.engine.execengine import ExecEngine
+    from dragonboat_tpu.engine.queue import EntryQueue, ReadIndexQueue
+    from dragonboat_tpu.storage.logdb import ShardedLogDB
+    from dragonboat_tpu.types import Entry
+
+    eng = ExecEngine(ShardedLogDB())
+    try:
+        p = eng.pressure_stats()
+        assert p == {"inbox_occupancy": 0.0, "staged_backlog": 0}
+        node = SimpleNamespace(
+            incoming_proposals=EntryQueue(size=8),
+            incoming_reads=ReadIndexQueue(size=8),
+        )
+        for i in range(3):
+            assert node.incoming_proposals.add(Entry(cmd=b"x"))
+        assert node.incoming_reads.add(object())
+        with eng._nodes_mu:
+            eng._nodes[1] = node
+        p = eng.pressure_stats()
+        assert p["staged_backlog"] == 4
+        assert p["inbox_occupancy"] == pytest.approx(3 / 8)
+        # the step worker draining the queues drains the backlog
+        node.incoming_proposals.get()
+        node.incoming_reads.get()
+        assert eng.pressure_stats()["staged_backlog"] == 0
+    finally:
+        with eng._nodes_mu:
+            eng._nodes.clear()
+        eng.stop()
+
+
 @pytest.fixture
 def clean_barrier_stats():
     reset_barrier_stats()

@@ -720,6 +720,18 @@ def test_restart_plane_locks_are_declared():
         assert g["engine/vector.py"]["VectorEngine"][fld] == "_lanes_mu"
 
 
+def test_device_census_targets_are_declared():
+    """ISSUE 18: the HBM census plane is covered by the lock config — a
+    leaf at the same rank as the other profile singletons, and its
+    plane table is declared _mu-guarded so an unlocked write flags."""
+    dc = DEFAULT_TARGETS.lock_rank("DeviceCensus", "_mu")
+    assert dc is not None, "DeviceCensus._mu missing from the hierarchy"
+    cw = DEFAULT_TARGETS.lock_rank("CompileWatch", "_mu")
+    assert dc.rank == cw.rank  # leaf rank, alongside the profile peers
+    g = DEFAULT_TARGETS.guarded_state
+    assert g["profile.py"]["DeviceCensus"]["_planes"] == "_mu"
+
+
 def test_restart_plane_guarded_state_catches_unlocked_free_list():
     """A lane free-list (or route/launch-spec) mutation outside its lock
     is exactly the double-free / stale-route restart bug class; the

@@ -539,3 +539,72 @@ def test_install_does_not_stall_engine_cadence(tmp_path):
                 nh.stop()
             except Exception:
                 pass
+
+
+# --------------------------------------------------------------------------
+# Leader-side SNAPSHOT parking recovery (regression)
+# --------------------------------------------------------------------------
+
+
+def test_parked_snapshot_remote_unwedges_without_receiver_ack(tmp_path):
+    """Regression: a streamed install whose receiver host dies after the
+    chunks leave the sender produces neither a transport failure (the
+    SnapshotLane completed cleanly) nor a SNAPSHOT_RECEIVED ack (the
+    receiver is gone). The scalar leader's Remote used to park in
+    RemoteState.SNAPSHOT forever — is_paused() blocks replication and no
+    heartbeat response can move a SNAPSHOT-state remote — so the rejoiner
+    was never replicated to again (longhaul streamed_install_under_crash
+    hit this as a convergence stall). Node._snapshot_feedback must feed a
+    synthetic rejected SnapshotStatus past the retry window, mirroring
+    the vector engine's _run_snapshot_feedback."""
+    from dragonboat_tpu.core.remote import Remote, RemoteState
+
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=6,
+            rtt_millisecond=2,
+            nodehost_dir=os.path.join(str(tmp_path), "h1"),
+            raft_address="wedge1:1",
+            raft_rpc_factory=lambda l, reg=reg: loopback_factory(l, reg),
+            engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "wedge1:1"},
+            False,
+            lambda c, n_: KV(),
+            Config(
+                cluster_id=CLUSTER, node_id=1,
+                election_rtt=10, heartbeat_rtt=2,
+            ),
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            lid, ok = nh.get_leader_id(CLUSTER)
+            if ok and lid == 1:
+                break
+            time.sleep(0.02)
+        node = nh.engine._nodes[CLUSTER]
+        r = node.peer.raft
+        assert r.is_leader()
+        # park a phantom follower exactly as _send_snapshot_message
+        # leaves it after handing the stream to the transport
+        rm = Remote(match=0, next=1)
+        rm.become_snapshot(100)
+        r.remotes[99] = rm
+        assert rm.state == RemoteState.SNAPSHOT
+        # retry window: max(4 * election_rtt, 16) = 40 ticks at 2ms rtt;
+        # the node's own LOCAL_TICK stream must un-park it with no
+        # receiver ack and no transport failure ever arriving
+        deadline = time.monotonic() + 10
+        while rm.state == RemoteState.SNAPSHOT and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rm.state != RemoteState.SNAPSHOT, (
+            "leader remote stayed parked in SNAPSHOT past the retry "
+            "window with no ack/failure feedback"
+        )
+        assert rm.snapshot_index == 0  # rejected status clears the pending
+    finally:
+        nh.stop()

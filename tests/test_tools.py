@@ -206,6 +206,105 @@ def test_request_snapshot_bad_export_path(tmp_path):
         nh.stop()
 
 
+def test_raft_top_renders_checked_in_snapshot_via_cli():
+    """ISSUE 18 acceptance: `python -m dragonboat_tpu.tools.top` renders
+    the checked-in snapshot fixture — header census/counter panel, lanes
+    ranked hottest-first (the churning lane with 6 elections and a
+    40-entry commit gap outranks everything), --json and --sort modes."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixture = os.path.join(repo, "tests", "data", "top_snapshot.json")
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "dragonboat_tpu.tools.top", *args],
+            cwd=repo, capture_output=True, text=True, timeout=60,
+        )
+
+    p = cli(fixture)
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = p.stdout.splitlines()
+    assert out[0].startswith("raft-top  lanes=4")
+    assert "hbm=52.0MiB" in out[0]
+    assert "waste=0.69" in out[0]
+    assert "elections 6/5" in out[1]
+    assert "backlog 3" in out[1]
+    # the table is ranked: the churning lane 101 leads
+    first_row = out[3].split()
+    assert first_row[1] == "101"
+    # --sort ingest re-ranks (all rates are 0 on a frozen view: stable)
+    assert cli(fixture, "--sort", "ingest").returncode == 0
+    # --limit truncates rows but keeps the header
+    p = cli(fixture, "--limit", "1")
+    assert len(p.stdout.splitlines()) == 4
+    # --json emits the ranked snapshot for downstream tooling
+    p = cli(fixture, "--json")
+    snap = json.loads(p.stdout)
+    assert snap["lanes"][0]["cluster_id"] == 101
+    assert snap["lanes"][0]["heat"] > snap["lanes"][-1]["heat"]
+    assert snap["census"]["hbm_waste_ratio"] == 0.69
+    # a non-snapshot file refuses cleanly
+    p = cli(os.path.join(repo, "tests", "data", "perfdiff_base.json"))
+    assert p.returncode == 2
+    assert "error" in p.stderr
+
+
+def test_raft_top_collects_and_ranks_from_live_host(tmp_path):
+    """collect_snapshot folds a live host's lane_stats/lane_counters/
+    census/pressure into the snapshot schema the CLI renders, and the
+    two-snapshot delta path derives ingest rates."""
+    from dragonboat_tpu.config import EngineConfig
+    from dragonboat_tpu.tools.top import collect_snapshot, rank_lanes, render
+    from tests.test_nodehost import KVSM
+    import io as _io
+
+    reg = _Registry()
+    nh = NodeHost(
+        NodeHostConfig(
+            deployment_id=1,
+            rtt_millisecond=5,
+            raft_address="top1:1",
+            raft_rpc_factory=lambda l: loopback_factory(l, reg),
+            engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "top1:1"}, False, lambda c, n: KVSM(c, n),
+            Config(cluster_id=1, node_id=1, election_rtt=10, heartbeat_rtt=2),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            lid, ok = nh.get_leader_id(1)
+            if ok and lid == 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("no leader")
+        sess = nh.get_noop_session(1)
+        first = collect_snapshot({1: nh})
+        for i in range(4):
+            nh.sync_propose(sess, f"k{i}=v".encode(), timeout_s=10.0)
+        snap = collect_snapshot({1: nh})
+        assert snap["schema"] == 1
+        rows = snap["lanes"]
+        assert len(rows) == 1 and rows[0]["cluster_id"] == 1
+        assert rows[0]["counters"]["commit_advances"] >= 4
+        assert snap["census"]["hbm_bytes_total"] == 0  # scalar engine
+        assert snap["counters"]["elections_won"] >= 1
+        # delta ranking derives a positive ingest rate from two snapshots
+        snap["ts"] = first["ts"] + 2.0  # pin dt: no wall-clock flake
+        ranked = rank_lanes(snap, prev=first)
+        assert ranked[0]["ingest_rate"] > 0
+        buf = _io.StringIO()
+        render(snap, prev=first, out=buf)
+        assert "raft-top  lanes=1" in buf.getvalue()
+    finally:
+        nh.stop()
+
+
 def test_logdb_checker_accepts_replicas_and_detects_divergence():
     """The logdb consistency checker passes identical replica logs and
     flags a committed-range divergence / commit-beyond-log violation
