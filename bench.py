@@ -386,6 +386,7 @@ def _bench_e2e_body(
         err.update(_attribution_report(hosts, None, None))
         err.update(_read_report(hosts, 0, 0.0, read_mode))
         err.update(_census_report(hosts))
+        err.update(_history_report(None))
         return err
     if drop_rate > 0 and shared:
         # randomized replication drops over the co-hosted path (the wire
@@ -417,6 +418,10 @@ def _bench_e2e_body(
 
     sync_mark = sync_audit().snapshot()
     compile_mark = compile_watch().install().snapshot()
+    # the history sampler runs through the measured window: its cost is
+    # part of the reported number, the attribution fold proves it stays
+    # sync- and retrace-free
+    hist = _start_history(workdir, hosts)
     if snap_fn is not None:
         for c, (lid, _t) in snap_fn().items():
             if lid and c in leaders:
@@ -427,6 +432,11 @@ def _bench_e2e_body(
             hosts, leaders, snap_fn, groups, duration_s, cmd, wave,
             max(tenants, 1), bring_up_s, steps_per_sync,
         )
+        if hist is not None:
+            try:
+                hist.stop()
+            except Exception:
+                pass
         out.update(_mesh_report(hosts, shard_over_mesh))
         out.update(_host_stage_report(hosts))
         out.update(_attribution_report(hosts, sync_mark, compile_mark))
@@ -435,6 +445,7 @@ def _bench_e2e_body(
         out.update(_serving_report(hosts))
         out.update(_read_report(hosts, 0, out["seconds"], read_mode))
         out.update(_census_report(hosts))
+        out.update(_history_report(hist))
         return out
     sessions = {
         c: hosts[leaders[c]].get_noop_session(c) for c in range(1, groups + 1)
@@ -535,6 +546,11 @@ def _bench_e2e_body(
             if rs.result is not None and rs.result.completed:
                 reads_done += 1
     dt = time.perf_counter() - t0
+    if hist is not None:
+        try:
+            hist.stop()
+        except Exception:
+            pass
     host_stages = _host_stage_report(hosts)
     out = {
         "value": (total + reads_done) / dt,
@@ -571,6 +587,7 @@ def _bench_e2e_body(
     out.update(_serving_report(hosts))
     out.update(_read_report(hosts, reads_done, dt, read_mode))
     out.update(_census_report(hosts))
+    out.update(_history_report(hist))
     return out
 
 
@@ -637,6 +654,34 @@ def _census_report(hosts) -> dict:
                     counters[name] += int(v)
     out["counters"] = counters
     return out
+
+
+def _history_report(sampler) -> dict:
+    """Telemetry-history sampler fold, ALWAYS present in every config
+    JSON (zero-filled when the sampler never started) so the schema
+    stays stable for tools.perfdiff — which shows the sampler's cost
+    informationally, never as a gate. The sampler runs LIVE through the
+    measured window: its per-sample cost is part of the number the bench
+    reports, and the runtime sync/retrace attribution below it proves
+    the sampling added zero device syncs and zero recompiles."""
+    from dragonboat_tpu.profile import HistorySampler
+
+    stats = (
+        sampler.stats() if sampler is not None
+        else HistorySampler.empty_stats()
+    )
+    return {f"history_{k}": v for k, v in stats.items()}
+
+
+def _start_history(workdir: str, hosts) -> object:
+    from dragonboat_tpu.profile import HistorySampler
+
+    try:
+        return HistorySampler(
+            os.path.join(workdir, "history.ring"), lambda: hosts
+        ).start()
+    except Exception:
+        return None  # telemetry must never block the bench
 
 
 def _front_measure(

@@ -325,6 +325,13 @@ def _default_targets() -> Targets:
             "HBM census plane table (leaf: written once at engine init, "
             "read by the 1/s export paths)",
         ),
+        LockSpec(
+            "HistorySampler", "_mu", 60,
+            "telemetry-history ring handle (leaf: the sampler thread "
+            "copies the ref out under it and writes the ring outside; "
+            "the sample itself only reads zero-sync stat exports, never "
+            "another lock)",
+        ),
     ]
     guarded_state = {
         TRANSPORT: {
@@ -363,6 +370,9 @@ def _default_targets() -> Targets:
             "SyncAudit": {"_out": "_mu"},
             "CompileWatch": {"_fns": "_mu"},
             "DeviceCensus": {"_planes": "_mu"},
+            # the history sampler's ring handle swaps on stop(); the
+            # plain-int sample/error counters are sampler-thread-only
+            "HistorySampler": {"_ring": "_mu"},
         },
         MANAGED: {
             "ManagedStateMachine": {"_destroyed": "_mu"},

@@ -18,7 +18,7 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..logger import get_logger
-from ..profile import DeviceCensus, phase_plane
+from ..profile import HOT_LANE_COUNTERS, DeviceCensus, phase_plane
 from ..settings import hard, soft
 from ..trace import LatencySampler, Profiler
 from ..types import Update
@@ -506,6 +506,29 @@ class ExecEngine:
                 "payload_bytes": payload,
             }
         return out
+
+    def hot_lane_stats(self, k: int):
+        """The k hottest groups by commit gap + the total the cap hides,
+        shape-compatible with VectorEngineHandle.hot_lane_stats():
+        (cluster_id -> lane_stats row + HOT_LANE_COUNTERS columns,
+        total). The scalar engine hosts few groups, so 'capped' is just
+        a sort here — the shape parity is what matters: the history
+        sampler and tools.top read one surface whichever engine runs."""
+        stats = self.lane_stats()
+        counters = self.lane_counters()
+        total = len(stats)
+        hottest = sorted(
+            stats.items(), key=lambda kv: kv[1]["commit_gap"], reverse=True
+        )[: max(1, int(k))]
+        out = {}
+        for cid, row in hottest:
+            row = dict(row)
+            c = counters.get(cid, {})
+            row["counters"] = {
+                name: int(c.get(name, 0)) for name in HOT_LANE_COUNTERS
+            }
+            out[cid] = row
+        return out, total
 
     def stop(self) -> None:
         self.watchdog.close()

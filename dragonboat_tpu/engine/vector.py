@@ -109,6 +109,7 @@ from ..ops.state import (
     rebase,
 )
 from ..profile import (
+    HOT_LANE_COUNTERS,
     DeviceCensus,
     compile_watch,
     note_engine_steps,
@@ -4196,6 +4197,65 @@ class VectorEngine:
             }
         return out
 
+    def hot_lane_stats(
+        self, k: int, host: Optional[int] = None
+    ) -> Tuple[Dict[tuple, dict], int]:
+        """The k hottest active lanes by commit gap (optionally filtered
+        to one co-hosted NodeHost), plus the total count the cap hides:
+        (lane key -> lane_stats row + heat-relevant counter columns,
+        total_active). Selection is one numpy gather + argpartition over
+        the decode-maintained mirrors — a 50k-lane host pays the
+        per-lane dict cost only for the k lanes somebody will look at
+        (the history sampler's slot-bounded lane table, tools.top's
+        default ranking input). Counter columns (HOT_LANE_COUNTERS) come
+        off the same cumulative host mirror as counter_stats — zero
+        device syncs, like everything on this surface."""
+        with self._lanes_mu:
+            lanes = [
+                lane
+                for lane in self._lanes.values()
+                if lane.active and (host is None or lane.key[0] == host)
+            ]
+        total = len(lanes)
+        out: Dict[tuple, dict] = {}
+        if not lanes:
+            return out, 0
+        k = max(1, int(k))
+        gs = np.fromiter((lane.g for lane in lanes), np.int64, total)
+        gaps = np.maximum(self._m_last[gs] - self._m_commit[gs], 0)
+        if total > k:
+            pick = np.argpartition(gaps, total - k)[total - k:]
+            # hottest-first order inside the cap (stable for renderers)
+            pick = pick[np.argsort(-gaps[pick], kind="stable")]
+        else:
+            pick = np.argsort(-gaps, kind="stable")
+        leader = self._m_leader
+        term = self._m_term
+        last = self._m_last
+        role = self._m_role
+        chg = self._m_leader_change_tick
+        tick = self.clock.tick
+        ctr = self._ctr
+        ctr_cols = [
+            (name, CTR_NAMES.index(name)) for name in HOT_LANE_COUNTERS
+        ]
+        for i in pick:
+            lane = lanes[int(i)]
+            g = lane.g
+            row = ctr[g]
+            out[lane.key] = {
+                "node_id": lane.node.node_id(),
+                "leader_id": lane.rev.get(int(leader[g]) - 1, 0),
+                "term": int(term[g]),
+                "commit_gap": int(gaps[int(i)]),
+                "last_index": int(last[g]),
+                "ticks_since_leader_change": max(int(tick - chg[g]), 0),
+                "role": int(role[g]),
+                "payload_bytes": lane.arena.payload_bytes,
+                "counters": {n: int(row[ci]) for n, ci in ctr_cols},
+            }
+        return out, total
+
     def leader_snapshot(self) -> Dict[tuple, Tuple[int, int]]:
         """One vectorized pass over the numpy mirrors: lane key ->
         (leader_node_id, term) for every active lane. Replaces per-group
@@ -4356,6 +4416,14 @@ class VectorEngineHandle:
             for key, v in self.core.lane_counters().items()
             if key[0] == self.host
         }
+
+    def hot_lane_stats(self, k: int) -> Tuple[Dict[int, dict], int]:
+        """This host's k hottest lanes by commit gap + its total active
+        lane count (see VectorEngine.hot_lane_stats). Host filtering
+        happens BEFORE the cap so a co-hosted fleet's noisy neighbour
+        can never crowd this host's lanes out of its own sample."""
+        rows, total = self.core.hot_lane_stats(k, host=self.host)
+        return {key[1]: v for key, v in rows.items()}, total
 
     def stop(self) -> None:
         self.core.release(self.host)

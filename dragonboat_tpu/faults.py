@@ -42,7 +42,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .storage.kv import IKVStore, WriteBatch
+from .storage.kv import IKVStore, WriteBatch, _BarrierStats
 from .trace import flight_recorder
 from .types import Message, MessageBatch, MessageType
 
@@ -496,6 +496,14 @@ class FaultyKV(IKVStore):
         self.plane = plane
         self.site = site
         self._fsync_observer = None
+        # the wrapper's OWN barrier ledger: ShardedLogDB.barrier_stats()
+        # aggregates per-store `bstats`, and with the wrapper in front
+        # the inner store's ledger is unreachable — worse, the inner
+        # ledger times only the REAL fsync, so an injected stall would
+        # vanish from the per-host WAL pressure signal (and from
+        # tools.doctor's wal_fsync_stall evidence) exactly when it
+        # matters most
+        self.bstats = _BarrierStats()
         # arm the per-record append seam when the store exposes one
         # (WalKV): the fault fires INSIDE a record group, before the
         # commit seal, which is the torn-batch case fsync faults can't
@@ -527,14 +535,15 @@ class FaultyKV(IKVStore):
         run's fsync_latency p99 would never line up with its
         fault_injected{kind="fsync_stall"} timeline."""
         obs = self._fsync_observer
-        if obs is None:
+        t0 = time.monotonic()
+        self.bstats.enter()
+        try:
             self.plane.maybe_fsync_fault(self.site)
             fn()
-            return
-        t0 = time.monotonic()
-        self.plane.maybe_fsync_fault(self.site)
-        fn()
-        obs(time.monotonic() - t0)
+        finally:
+            self.bstats.exit(time.monotonic() - t0)
+        if obs is not None:
+            obs(time.monotonic() - t0)
 
     def commit_write_batch(self, wb: WriteBatch) -> None:
         self._timed_barrier(lambda: self.inner.commit_write_batch(wb))

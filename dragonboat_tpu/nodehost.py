@@ -37,7 +37,7 @@ from .requests import (
 from .rsm import SSRequest, SS_REQ_EXPORTED, SS_REQ_USER
 from .statemachine import Result, sm_type_of
 from .storage import LogReader, ShardedLogDB
-from .profile import compile_watch, sync_audit
+from .profile import HistorySampler, compile_watch, sync_audit
 from .profile import write_exposition as _write_profile_exposition
 from .trace import flight_recorder, read_mmap_ring
 from .transport import Transport, loopback_factory
@@ -238,6 +238,20 @@ class NodeHost(IMessageHandler):
                 flight_recorder().attach_mmap(ring_path)
             except Exception:
                 pass  # forensics must never block bring-up
+        # telemetry history ring (profile.HistorySampler): a background
+        # sampler turning this host's zero-sync stat surfaces into a
+        # crash-persistent time series next to the flight ring.
+        # DRAGONBOAT_HISTORY_RING=<path> auto-starts it at bring-up
+        # (tools.doctor reads the ring back); start_history() is the
+        # programmatic path (tools.longhaul samples a whole fleet into
+        # one per-round ring instead).
+        self._history: Optional[HistorySampler] = None
+        hist_path = os.environ.get("DRAGONBOAT_HISTORY_RING")
+        if hist_path:
+            try:
+                self.start_history(hist_path)
+            except Exception:
+                pass  # forensics must never block bring-up
 
     def _acquire_dir_lock(self) -> None:
         """Exclusive advisory lock on the nodehost dir (cf. reference
@@ -299,6 +313,14 @@ class NodeHost(IMessageHandler):
 
     def _teardown(self, crashed: bool) -> None:
         self._stopped.set()
+        # history sampler dies FIRST: it reads engine/logdb surfaces that
+        # are about to close under it. Graceful stop flushes one final
+        # sample; a crash abandons the ring mid-write like a SIGKILL
+        # would — recovering THAT state is what the ring is for.
+        try:
+            self.stop_history(final_sample=not crashed)
+        except Exception:
+            pass  # forensics must never block teardown
         with self._serving_mu:
             front, self._serving = self._serving, None
             plane, self._placement = self._placement, None
@@ -448,6 +470,43 @@ class NodeHost(IMessageHandler):
         an ordered event list (see trace.read_mmap_ring)."""
         _meta, events = read_mmap_ring(path)
         return events
+
+    def start_history(
+        self,
+        path: Optional[str] = None,
+        interval_s: Optional[float] = None,
+        **kw,
+    ) -> HistorySampler:
+        """Start the telemetry history sampler for THIS host: every
+        ``interval_s`` (profile.HISTORY_INTERVAL_S default) a bounded
+        snapshot of the zero-sync stat surfaces lands in a
+        crash-persistent ring at ``path`` (default
+        ``<nodehost_dir>/history.ring``, next to the WAL). Idempotent —
+        a second call returns the running sampler. Entirely off the
+        engine step path; the ``engine_history_*`` gauges report its
+        measured cost."""
+        if self._history is not None:
+            return self._history
+        if path is None:
+            path = os.path.join(self._dir, "history.ring")
+        if interval_s is not None:
+            kw["interval_s"] = interval_s
+        self._history = HistorySampler(path, {0: self}, **kw).start()
+        return self._history
+
+    def stop_history(self, final_sample: bool = True) -> None:
+        """Stop the history sampler (graceful path takes one final
+        sample so the last state of a clean shutdown is on disk too).
+        No-op when no sampler is running."""
+        sampler, self._history = self._history, None
+        if sampler is not None:
+            sampler.stop(final_sample=final_sample)
+
+    def clock_anomalies(self) -> int:
+        """Cumulative tick-clock fault count (the tick worker's
+        divergence detector) — the history sampler's clock-fault
+        series and tools.doctor's clock_anomaly signal."""
+        return self._clock_anomalies
 
     # ------------------------------------------------------------ start paths
     def start_cluster(
@@ -1511,6 +1570,16 @@ class NodeHost(IMessageHandler):
             "engine_compile_events_total", (0, 0),
             float(compile_watch().total),
         )
+        # history-sampler cost accounting: ALWAYS exported (zero-filled
+        # when no sampler runs) so the engine_history_* schema is stable
+        # and a dashboard can prove the sampler's overhead stayed noise
+        sampler = self._history
+        hs = (
+            sampler.stats() if sampler is not None
+            else HistorySampler.empty_stats()
+        )
+        for hname, v in hs.items():
+            self.metrics.set_gauge(f"engine_history_{hname}", (0, 0), float(v))
         # HBM census: device-plane bytes + per-lane log fill vs the dense
         # widest-lane allocation (VectorEngine folds from its numpy
         # mirrors, the scalar engine reports an all-zero shape twin) —

@@ -39,19 +39,34 @@ attribution itself a first-class, always-exported plane:
     gauges; ``bench.py`` folds the measurement-window delta into every
     config's JSON and ``tools.perfdiff --gate`` fails on growth).
 
+  * ``HistorySampler`` — the diagnosis plane's TIME axis: a background
+    thread that, every ``interval_s`` (default 250ms, entirely off the
+    step loop), snapshots every zero-sync stat surface a host exports —
+    lane stats (capped to the hottest K lanes), protocol counters,
+    pressure, HBM census, leases, clock anomalies, WAL barrier
+    latencies, serving/placement gauges — into a crash-persistent
+    ``MmapRing`` (trace.py framing, bigger slots) next to the flight
+    ring. Lifetime counters become windowed rates, and a SIGKILL leaves
+    the last N seconds of fleet state on disk for ``tools.doctor`` to
+    read back. Samples are flight-compatible events
+    (``event=history_sample``) so ``tools.timeline`` merges a history
+    ring like any other forensic artifact.
+
 jax is imported lazily (inside ``install()``) so this module — like the
 analysis package — stays importable in jax-free contexts
 (``tools.perfdiff`` reads bench JSONs without ever touching a backend).
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from .events import Histogram, write_histogram_series, _labels
-from .trace import flight_recorder
+from .trace import _RING_MAGIC, MmapRing, flight_recorder, read_mmap_ring
 
 # canonical step-phase vocabulary. The vector engine's step loop
 # (VectorEngine._run_once + _decode) times every stage of a kernel step;
@@ -548,6 +563,364 @@ def diff_compiles(before: dict, after: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# telemetry history ring (the diagnosis plane's time axis)
+# ---------------------------------------------------------------------------
+
+# every history sample is a flight-compatible event: it carries `t`
+# (monotonic seconds) and `event`, so tools.timeline merges a history
+# ring into a forensic timeline like any other swept artifact, and
+# tools.doctor filters the samples back out by event name
+HISTORY_EVENT = "history_sample"
+HISTORY_SCHEMA = 1
+# sampler defaults: 250ms cadence; ring sized so a 4-host fleet keeps
+# ~60s of history (one slot per host per tick). Slots are 16x the flight
+# ring's 512B because one sample is a whole host snapshot, not a
+# breadcrumb — the capped lane table is what keeps it under one slot.
+HISTORY_INTERVAL_S = 0.25
+HISTORY_MAX_LANES = 16
+HISTORY_RING_CAPACITY = 1024
+HISTORY_RING_SLOT = 8192
+
+# the counter columns a hot-lane row carries (joined per lane by the
+# engines' hot_lane_stats): exactly the per-lane inputs of tools.top's
+# heat formula plus the election-outcome pair tools.doctor's quorum
+# rules difference — NOT all of CTR_NAMES, so K lane rows stay small
+# enough that a full sample fits one history slot
+HOT_LANE_COUNTERS = (
+    "elections_started",
+    "elections_won",
+    "replicate_rejects",
+    "commit_advances",
+    "lease_fallback",
+)
+
+# the always-present sampler gauge schema (engine_history_* in the
+# Prometheus exposition, `history` fold in the bench JSON): zero-filled
+# when no sampler is attached so consumers never branch
+HISTORY_STATS_KEYS = (
+    "samples_total",
+    "errors_total",
+    "last_sample_seconds",
+    "sample_cost_seconds_total",
+    "interval_seconds",
+)
+
+
+def _capped_lanes(eng, max_lanes: int):
+    """(rows, total_active) from the engine's capped hot-lane accessor,
+    falling back to a full lane_stats fold for engines that predate it.
+    Rows are stringified-cluster-id keyed (JSON object keys)."""
+    hot = getattr(eng, "hot_lane_stats", None)
+    if callable(hot):
+        rows, total = hot(max_lanes)
+    else:
+        stats = eng.lane_stats()
+        total = len(stats)
+        hottest = sorted(
+            stats.items(),
+            key=lambda kv: kv[1].get("commit_gap", 0),
+            reverse=True,
+        )[: max(1, int(max_lanes))]
+        rows = dict(hottest)
+    out = {}
+    for key, row in rows.items():
+        if isinstance(key, tuple):  # core-level (host, cluster_id) key
+            key = f"{key[0]}:{key[1]}"
+        out[str(key)] = row
+    return out, int(total)
+
+
+def sample_host(nh, max_lanes: int = HISTORY_MAX_LANES) -> dict:
+    """One bounded snapshot of a live NodeHost's zero-sync stat surfaces
+    — the HistorySampler's unit of work, also usable synchronously
+    (tools.doctor's in-process ``diagnose`` takes two of these and
+    differences them).
+
+    Zero-sync by construction: every source below reads decode-
+    maintained numpy mirrors or plain host ints (lane_stats /
+    counter_stats / pressure_stats / device_census / lease_stats
+    contracts), the WAL barrier ledger, and the serving/placement
+    planes' Python counters. Nothing here may touch the device — the
+    ``-m perf`` audit in tests/test_profile.py pins it. Sources that
+    fail (engine mid-teardown, no serving front) zero-fill and are named
+    in the sample's ``errors`` list rather than raising."""
+    d = {
+        "event": HISTORY_EVENT,
+        "schema": HISTORY_SCHEMA,
+        "t": round(time.monotonic(), 6),
+        "host": getattr(getattr(nh, "config", None), "raft_address", ""),
+        "cluster": 0,  # host-level event (flight-recorder convention)
+    }
+    errors = []
+    eng = getattr(nh, "engine", None)
+
+    def _take(name, fn, default):
+        try:
+            d[name] = fn()
+        except Exception:
+            d[name] = default
+            errors.append(name)
+
+    if eng is not None:
+        try:
+            rows, total = _capped_lanes(eng, max_lanes)
+            d["lanes"] = rows
+            d["lanes_total"] = total
+            d["lanes_dropped"] = max(0, total - len(rows))
+        except Exception:
+            d["lanes"], d["lanes_total"], d["lanes_dropped"] = {}, 0, 0
+            errors.append("lanes")
+        _take("counters", lambda: dict(eng.counter_stats()), {})
+        _take("pressure", lambda: dict(eng.pressure_stats()), {})
+        _take(
+            "lease",
+            lambda: dict(eng.lease_stats()),
+            {"local": 0, "fallback": 0},
+        )
+
+        def _census_lite():
+            c = eng.device_census()
+            return {
+                "hbm_bytes_total": int(c.get("hbm_bytes_total", 0)),
+                "hbm_waste_ratio": float(c.get("hbm_waste_ratio", 0.0)),
+                "lanes_active": int(c.get("lanes_active", 0)),
+            }
+
+        _take("census", _census_lite, {})
+
+        def _fairness_gap():
+            fairness = getattr(eng, "fairness_stats", None)
+            if fairness is None:
+                return 0.0
+            return float(fairness().get("recent_max_gap_s", 0.0))
+
+        _take("fairness_gap_s", _fairness_gap, 0.0)
+    # host-level clock-fault ledger (tick worker's divergence detector)
+    _take(
+        "clock_anomalies",
+        lambda: int(nh.clock_anomalies()),
+        0,
+    )
+    # WAL durability-barrier ledger: ewma/last fsync-wave latency —
+    # tools.doctor's wal_fsync_stall signal
+    _take(
+        "wal",
+        lambda: {
+            k: round(float(v), 6) if isinstance(v, float) else int(v)
+            for k, v in nh.logdb.barrier_stats().items()
+        },
+        {},
+    )
+
+    # serving/placement planes: observe-only — `_serving`/`_placement`
+    # are read lock-free exactly like NodeHost._export_health_gauges
+    # does (the sampler must never instantiate a front on an idle host)
+    def _serving_fold():
+        front = getattr(nh, "_serving", None)
+        if front is None:
+            return {"admitted": 0, "shed": 0, "queue_depth": 0,
+                    "saturation": 0.0}
+        admitted = shed = 0
+        for row in front.admission.counters().values():
+            admitted += sum(row.get("admitted", {}).values())
+            shed += sum(row.get("shed", {}).values())
+        queue = sum(front.queue_depths().values())
+        return {
+            "admitted": int(admitted),
+            "shed": int(shed),
+            "queue_depth": int(queue),
+            "saturation": round(float(front.monitor.score()), 6),
+        }
+
+    _take(
+        "serving",
+        _serving_fold,
+        {"admitted": 0, "shed": 0, "queue_depth": 0, "saturation": 0.0},
+    )
+
+    def _migration_fold():
+        plane = getattr(nh, "_placement", None)
+        if plane is None:
+            return {"started": 0, "completed": 0, "aborted": 0, "active": 0}
+        c = plane.counters()
+        return {
+            "started": int(c.get("migrations_started", 0)),
+            "completed": int(c.get("migrations_completed", 0)),
+            "aborted": int(c.get("migrations_aborted", 0)),
+            "active": int(c.get("active", 0)),
+        }
+
+    _take(
+        "migrations",
+        _migration_fold,
+        {"started": 0, "completed": 0, "aborted": 0, "active": 0},
+    )
+    if errors:
+        d["errors"] = errors
+    return d
+
+
+class HistorySampler:
+    """Per-process background sampler feeding a crash-persistent history
+    ring (the flight ring's MmapRing framing with history-sized slots).
+
+    ``hosts`` is a mapping (key -> NodeHost) or a zero-arg callable
+    returning one — the callable form is for fleets whose membership
+    changes under the sampler (tools.longhaul crash/restart rounds).
+    One slot is written per live host per tick; a host that dies between
+    ticks simply stops appearing, and its final pre-crash samples are
+    exactly what the ring exists to preserve.
+
+    Entirely off the engines' step path: the thread wakes every
+    ``interval_s``, reads the zero-sync surfaces (sample_host) and does
+    one json.dumps + MmapRing.write per host. A pre-existing ring at
+    ``path`` rotates to ``<path>.prev`` first — same preservation
+    contract as FlightRecorder.attach_mmap. ``stop()`` takes one final
+    sample so a graceful shutdown's last state is on disk too."""
+
+    def __init__(
+        self,
+        path: str,
+        hosts,
+        interval_s: float = HISTORY_INTERVAL_S,
+        capacity: int = HISTORY_RING_CAPACITY,
+        slot_size: int = HISTORY_RING_SLOT,
+        max_lanes: int = HISTORY_MAX_LANES,
+    ) -> None:
+        self.path = path
+        self.interval_s = max(0.01, float(interval_s))
+        self.max_lanes = int(max_lanes)
+        self._hosts = hosts
+        self._mu = threading.Lock()
+        try:
+            with open(path, "rb") as f:
+                had_ring = f.read(len(_RING_MAGIC)) == _RING_MAGIC
+            if had_ring:
+                os.replace(path, path + ".prev")
+        except OSError:
+            pass  # no previous ring (or unreadable): nothing to preserve
+        self._ring: Optional[MmapRing] = MmapRing(
+            path, capacity=capacity, slot_size=slot_size
+        )
+        # plain-int telemetry (torn reads cost one stale gauge sample)
+        self.samples_total = 0
+        self.errors_total = 0
+        self.last_sample_s = 0.0
+        self.cost_s_total = 0.0
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- hosts
+    def _host_map(self) -> dict:
+        hosts = self._hosts
+        if callable(hosts):
+            try:
+                hosts = hosts()
+            except Exception:
+                hosts = {}
+        return dict(hosts or {})
+
+    # ----------------------------------------------------------- sampling
+    def sample_once(self) -> int:
+        """Take one sample of every live host NOW (also the final-flush
+        path); returns the number of slots written."""
+        t0 = time.monotonic()
+        with self._mu:
+            ring = self._ring
+        if ring is None:
+            return 0
+        wrote = 0
+        for _key, nh in sorted(
+            self._host_map().items(), key=lambda kv: str(kv[0])
+        ):
+            if nh is None:
+                continue
+            try:
+                d = sample_host(nh, max_lanes=self.max_lanes)
+                ring.write(
+                    json.dumps(d, default=str, sort_keys=True).encode()
+                )
+                wrote += 1
+            except Exception:
+                # a host mid-crash must never kill the sampler; the gap
+                # in its series is itself a diagnostic signal
+                self.errors_total += 1
+        dt = time.monotonic() - t0
+        self.samples_total += wrote
+        self.last_sample_s = dt
+        self.cost_s_total += dt
+        return wrote
+
+    def _run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            self.sample_once()
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "HistorySampler":
+        if self._thread is not None:
+            return self
+        self._stop_ev.clear()
+        t = threading.Thread(
+            target=self._run, name="history-sampler", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def stop(self, final_sample: bool = True) -> None:
+        self._stop_ev.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+        if final_sample:
+            try:
+                self.sample_once()
+            except Exception:
+                pass
+        with self._mu:
+            ring, self._ring = self._ring, None
+        if ring is not None:
+            ring.close()
+
+    def flush(self) -> None:
+        with self._mu:
+            ring = self._ring
+        if ring is not None:
+            ring.flush()
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """The engine_history_* gauge schema (HISTORY_STATS_KEYS)."""
+        return {
+            "samples_total": int(self.samples_total),
+            "errors_total": int(self.errors_total),
+            "last_sample_seconds": round(self.last_sample_s, 6),
+            "sample_cost_seconds_total": round(self.cost_s_total, 6),
+            "interval_seconds": self.interval_s,
+        }
+
+    @staticmethod
+    def empty_stats() -> dict:
+        """Zero-filled stats schema for hosts with no sampler attached —
+        gauges and bench JSON keys stay ALWAYS present."""
+        return {
+            "samples_total": 0,
+            "errors_total": 0,
+            "last_sample_seconds": 0.0,
+            "sample_cost_seconds_total": 0.0,
+            "interval_seconds": 0.0,
+        }
+
+
+def read_history(path: str):
+    """Recover a (possibly SIGKILL'd) process's history ring: returns
+    (meta, samples) with samples seal-ordered; non-sample events that
+    share the ring (none today) are filtered out by event name."""
+    meta, events = read_mmap_ring(path)
+    return meta, [e for e in events if e.get("event") == HISTORY_EVENT]
+
+
+# ---------------------------------------------------------------------------
 # process-global singletons (like trace.flight_recorder: every engine and
 # NodeHost in the process feeds one plane, and the exposition/bench folds
 # read it without plumbing)
@@ -604,6 +977,12 @@ __all__ = [
     "CompileWatch",
     "DeviceCensus",
     "EXEC_PHASES",
+    "HISTORY_EVENT",
+    "HISTORY_INTERVAL_S",
+    "HISTORY_MAX_LANES",
+    "HISTORY_STATS_KEYS",
+    "HOT_LANE_COUNTERS",
+    "HistorySampler",
     "PhasePlane",
     "SyncAudit",
     "VECTOR_PHASES",
@@ -613,6 +992,8 @@ __all__ = [
     "note_engine_steps",
     "note_seam_sync",
     "phase_plane",
+    "read_history",
+    "sample_host",
     "sync_audit",
     "write_exposition",
 ]

@@ -25,7 +25,17 @@ Matching, and the tick-fairness watchdog's graceful-degradation check),
 a per-round seed line so ANY round replays from the log, and a forensic
 artifact bundle on failure: every live host's flight dump plus every
 `*.ring`/`*.ring.prev` crash ring swept from the run directory, merged
-into one timeline (tools.timeline) — no manual collection.
+into one timeline (tools.timeline), the round's telemetry history ring
+(profile.HistorySampler — every host sampled at 250ms into a
+crash-persistent ring next to the flight ring) and the raft-doctor
+diagnosis over all three planes (tools.doctor) — no manual collection.
+Failed rounds are triaged: deduped by (failed-verdicts, diagnosis)
+signature, each NEW signature auto-replayed once at the same seed, and
+tagged DETERMINISTIC (replay fails the same way — debug from the
+bundle) or LOAD_SENSITIVE (replay diverged — suspect timing/box load)
+in the run's triage.json ledger. Out dirs are single-use: a non-empty
+--out is rotated to <out>.prev (stale h<N> dirs replay old WAL state
+and fail lincheck spuriously); --reuse-out skips the guard.
 
 Usage:
 
@@ -51,6 +61,7 @@ import argparse
 import hashlib
 import json
 import os
+import shutil
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -60,12 +71,14 @@ from ..config import Config, EngineConfig, NodeHostConfig
 from ..faults import ClockPlane, FaultPlane, FaultSpec
 from ..lincheck import HistoryRecorder, check_kv_history
 from ..nodehost import NodeHost
+from ..profile import HISTORY_EVENT, HistorySampler
 from ..requests import RequestError
 from ..statemachine import IStateMachine, Result
 from ..storage import ShardedLogDB
 from ..storage.kv import WalKV
 from ..trace import flight_recorder
 from ..transport.loopback import _Registry, loopback_factory
+from .doctor import diagnosis_report, load_history
 from .timeline import merge_dumps, sweep_artifacts
 from .top import collect_snapshot, rank_lanes
 
@@ -143,6 +156,8 @@ class RoundResult:
     error: str = ""
     bundle: str = ""
     replay: str = ""
+    diagnosis: str = ""  # raft-doctor's top verdict kind (failed rounds)
+    triage: str = ""  # DETERMINISTIC | LOAD_SENSITIVE (failed rounds)
 
 
 @dataclass
@@ -156,6 +171,8 @@ class Options:
     rotate: bool = False
     ring: bool = False  # attach a per-round crash-persistent mmap ring
     inject_failure: bool = False  # force a failing verdict (bundle drill)
+    reuse_out: bool = False  # skip the fresh-out-dir rotation guard
+    triage: bool = True  # dedupe + same-seed-replay failed rounds
     scenarios: tuple = SCENARIOS
     # vector-engine composition knobs: the smoke rotation soaks the
     # sharded K-step kernel (shard_over_mesh + steps_per_sync>1) under
@@ -163,6 +180,26 @@ class Options:
     # both
     steps_per_sync: int = 1
     shard_over_mesh: bool = False
+
+
+def _prepare_out_dir(out_dir: str, reuse: bool = False) -> bool:
+    """Longhaul out dirs are single-use: reusing a populated run dir
+    makes restarted hosts replay STALE WAL state from its h<N> dirs and
+    fail lincheck spuriously (a flake that looks exactly like a real
+    consistency bug). Unless ``reuse`` is set, a non-empty out dir is
+    rotated aside to ``<out>.prev`` (replacing any older .prev) so every
+    run starts fresh; returns True when a rotation happened."""
+    if not reuse and os.path.isdir(out_dir) and os.listdir(out_dir):
+        prev = out_dir.rstrip(os.sep) + ".prev"
+        if os.path.isdir(prev):
+            shutil.rmtree(prev, ignore_errors=True)
+        elif os.path.exists(prev):
+            os.remove(prev)
+        os.replace(out_dir, prev)
+        os.makedirs(out_dir, exist_ok=True)
+        return True
+    os.makedirs(out_dir, exist_ok=True)
+    return False
 
 
 def _round_seed(master: int, round_no: int, rotate: bool) -> int:
@@ -308,12 +345,17 @@ class _Round:
     """One seeded round: 3 hosts + churn host, client traffic, a fixed
     count of seeded scenario ops, then settle + verdicts + artifacts."""
 
-    def __init__(self, round_no: int, seed: int, opts: Options) -> None:
+    def __init__(
+        self, round_no: int, seed: int, opts: Options, dir_suffix: str = ""
+    ) -> None:
         self.no = round_no
         self.seed = seed
         self.opts = opts
+        # dir_suffix keeps triage replays out of the original round dir:
+        # restarting hosts over a populated h<N> dir replays stale WAL
+        # state and fails lincheck spuriously
         self.dir = os.path.join(
-            opts.out_dir, f"round-{round_no:03d}-seed-0x{seed:X}"
+            opts.out_dir, f"round-{round_no:03d}-seed-0x{seed:X}{dir_suffix}"
         )
         os.makedirs(self.dir, exist_ok=True)
         self.fp = FaultPlane(
@@ -370,6 +412,7 @@ class _Round:
         }
         self._clock_gen = None
         self._rec: Optional[HistoryRecorder] = None
+        self._hist: Optional[HistorySampler] = None
 
     # ------------------------------------------------------------ lifecycle
     def run(self) -> RoundResult:
@@ -382,6 +425,19 @@ class _Round:
                 )
             except Exception:
                 pass  # forensics must never block the run
+        try:
+            # the round's telemetry history: a background sampler over
+            # whichever hosts are alive at each tick (the dict mutates
+            # during crash/restart rounds, hence the callable), into a
+            # crash-persistent ring next to the flight ring
+            self._hist = HistorySampler(
+                os.path.join(self.dir, "history.ring"),
+                lambda: {
+                    n: h for n, h in self.hosts.items() if h is not None
+                },
+            ).start()
+        except Exception:
+            self._hist = None  # forensics must never block the run
         rec = HistoryRecorder()
         self._rec = rec  # lease burst reads record into the SAME history
         stop = threading.Event()
@@ -428,6 +484,13 @@ class _Round:
                 res.verdicts["injected_failure"] = False
             res.ok = bool(res.verdicts) and all(res.verdicts.values())
             res.ops = len(rec.history())
+            if self._hist is not None:
+                try:
+                    # seal the ring (with one final sample) BEFORE the
+                    # bundle sweep and before any host surface closes
+                    self._hist.stop(final_sample=True)
+                except Exception:
+                    pass
             if not res.ok:
                 try:
                     self._bundle_failure()
@@ -1252,6 +1315,38 @@ class _Round:
                     json.dump(snap["census"], f, indent=2, sort_keys=True)
             except Exception:
                 census_path = top_path = None  # hosts mid-teardown
+        # telemetry history (the sampler sealed the ring before this
+        # sweep ran) + the raft-doctor diagnosis over all three planes:
+        # history ring, merged flight timeline, frozen top snapshot
+        hist_path = diag_path = None
+        hist_src = os.path.join(self.dir, "history.ring")
+        if os.path.exists(hist_src):
+            try:
+                hist_path = os.path.join(bundle, "history.ring")
+                shutil.copyfile(hist_src, hist_path)
+            except OSError:
+                hist_path = None
+        try:
+            history = load_history(hist_path) if hist_path else []
+            top = None
+            if top_path is not None:
+                with open(top_path) as f:
+                    top = json.load(f)
+            diag = diagnosis_report(
+                history,
+                flight=[
+                    e for e in merged if e.get("event") != HISTORY_EVENT
+                ],
+                top=top,
+                source=os.path.basename(self.dir),
+            )
+            if diag["verdicts"]:
+                self.result.diagnosis = diag["verdicts"][0]["kind"]
+            diag_path = os.path.join(bundle, "diagnosis.json")
+            with open(diag_path, "w") as f:
+                json.dump(diag, f, indent=2, sort_keys=True)
+        except Exception:
+            diag_path = None  # diagnosis must never mask the verdict
         self.result.replay = self._replay_cmd()
         manifest = {
             "round": self.no,
@@ -1267,6 +1362,9 @@ class _Round:
             "merged_events": len(merged),
             "device_census": census_path,
             "top_snapshot": top_path,
+            "history_ring": hist_path,
+            "diagnosis": diag_path,
+            "doctor_verdict": self.result.diagnosis,
             "replay": self.result.replay,
         }
         with open(os.path.join(bundle, "manifest.json"), "w") as f:
@@ -1289,11 +1387,75 @@ class _Round:
         return cmd
 
 
+# --------------------------------------------------------------- triage
+def _triage_signature(res: RoundResult) -> str:
+    """Dedupe key for the triage ledger: failed rounds that fail the
+    SAME verdict set with the SAME doctor diagnosis are one flake
+    signature, whatever seed produced them."""
+    bad = ",".join(sorted(k for k, ok in res.verdicts.items() if not ok))
+    return hashlib.sha256(f"{bad}|{res.diagnosis}".encode()).hexdigest()[:12]
+
+
+def _triage_round(
+    res: RoundResult, seed: int, opts: Options, ledger: Dict[str, dict]
+) -> None:
+    """Triage one failed round. The FIRST round showing a signature is
+    replayed once at the same seed (in a fresh ``-triage`` dir — see
+    _prepare_out_dir for why reuse is poison): a replay that fails the
+    same verdicts tags the signature DETERMINISTIC (a seed replays it —
+    debug from the bundle); anything else (green, or a different verdict
+    set) tags it LOAD_SENSITIVE (timing-dependent — suspect box load or
+    thresholds, not the seed). Later rounds with a known signature just
+    join its ledger entry."""
+    sig = _triage_signature(res)
+    entry = ledger.get(sig)
+    if entry is not None:
+        entry["rounds"].append(res.round_no)
+        res.triage = entry["tag"]
+        return
+    entry = ledger[sig] = {
+        "signature": sig,
+        "verdicts": sorted(k for k, ok in res.verdicts.items() if not ok),
+        "diagnosis": res.diagnosis,
+        "rounds": [res.round_no],
+        "seed": f"0x{seed:X}",
+        "tag": "",
+    }
+    print(
+        f"[longhaul] triage: new signature {sig} "
+        f"verdicts={entry['verdicts']} "
+        f"diagnosis={res.diagnosis or '-'} — replaying seed=0x{seed:X}",
+        flush=True,
+    )
+    rep = _Round(res.round_no, seed, opts, dir_suffix="-triage").run()
+    rep_bad = sorted(k for k, ok in rep.verdicts.items() if not ok)
+    deterministic = not rep.ok and rep_bad == entry["verdicts"]
+    entry["tag"] = "DETERMINISTIC" if deterministic else "LOAD_SENSITIVE"
+    res.triage = entry["tag"]
+    print(f"[longhaul] triage: signature {sig} -> {entry['tag']}", flush=True)
+
+
+def _write_triage(out_dir: str, master: int, ledger: Dict[str, dict]) -> str:
+    path = os.path.join(out_dir, "triage.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "schema": 1,
+                "master_seed": f"0x{master:X}",
+                "entries": sorted(
+                    ledger.values(), key=lambda e: e["signature"]
+                ),
+            },
+            f, indent=2, sort_keys=True,
+        )
+    return path
+
+
 def run_longhaul(opts: Options) -> dict:
     """Run rounds until the wall-clock budget (or --rounds cap) is spent;
     returns {rounds: [RoundResult...], ok, ...}. Each round prints one
     summary line; failures print the bundle path + replay command."""
-    os.makedirs(opts.out_dir, exist_ok=True)
+    rotated = _prepare_out_dir(opts.out_dir, reuse=opts.reuse_out)
     master = (
         opts.seed
         if opts.seed is not None
@@ -1302,11 +1464,13 @@ def run_longhaul(opts: Options) -> dict:
     )
     t_end = time.monotonic() + opts.budget_s
     results: List[RoundResult] = []
+    triage: Dict[str, dict] = {}
     round_no = 0
     print(
         f"[longhaul] budget={opts.budget_s:g}s master-seed=0x{master:X} "
         f"rotation={'on' if opts.rotate else 'off'} engine={opts.engine} "
-        f"out={opts.out_dir}",
+        f"out={opts.out_dir}"
+        + (" (rotated stale run to .prev)" if rotated else ""),
         flush=True,
     )
     while time.monotonic() < t_end:
@@ -1328,15 +1492,22 @@ def run_longhaul(opts: Options) -> dict:
             print(
                 f"[longhaul] round {res.round_no} FAILED "
                 f"verdicts={bad} error={res.error or '-'} "
+                f"diagnosis={res.diagnosis or '-'} "
                 f"bundle={res.bundle or '-'}",
                 flush=True,
             )
             if res.replay:
                 print(f"[longhaul] replay: {res.replay}", flush=True)
+            if opts.triage:
+                _triage_round(res, seed, opts, triage)
     ok = bool(results) and all(r.ok for r in results)
+    triage_path = ""
+    if opts.triage:
+        triage_path = _write_triage(opts.out_dir, master, triage)
     print(
         f"[longhaul] done: {len(results)} round(s), "
-        f"{sum(1 for r in results if not r.ok)} failure(s)",
+        f"{sum(1 for r in results if not r.ok)} failure(s), "
+        f"{len(triage)} triage signature(s)",
         flush=True,
     )
     return {
@@ -1344,6 +1515,9 @@ def run_longhaul(opts: Options) -> dict:
         "master_seed": master,
         "rounds": results,
         "budget_s": opts.budget_s,
+        "out_dir_rotated": rotated,
+        "triage": sorted(triage.values(), key=lambda e: e["signature"]),
+        "triage_path": triage_path,
     }
 
 
@@ -1370,9 +1544,19 @@ def main(argv=None) -> int:
     ap.add_argument("--engine", choices=("vector", "scalar"),
                     default="vector")
     ap.add_argument("--out", default="longhaul-out",
-                    help="run directory (round dirs + failure bundles)")
+                    help="run directory (round dirs + failure bundles); "
+                         "a non-empty one is rotated to <out>.prev — "
+                         "reusing stale h<N> dirs replays old WAL state "
+                         "and fails lincheck spuriously")
+    ap.add_argument("--reuse-out", action="store_true",
+                    help="dangerous: run in a non-empty --out dir as-is "
+                         "(skips the .prev rotation guard)")
     ap.add_argument("--no-ring", action="store_true",
                     help="skip the per-round crash-persistent mmap ring")
+    ap.add_argument("--no-triage", action="store_true",
+                    help="skip the failure-triage ledger (signature "
+                         "dedupe + one same-seed replay per signature "
+                         "-> DETERMINISTIC/LOAD_SENSITIVE tags)")
     ap.add_argument("--inject-failure", action="store_true",
                     help="force a failing verdict each round (drills the "
                          "artifact bundle + replay-command path)")
@@ -1395,6 +1579,8 @@ def main(argv=None) -> int:
             rotate=args.seed_rotation,
             ring=not args.no_ring,
             inject_failure=args.inject_failure,
+            reuse_out=args.reuse_out,
+            triage=not args.no_triage,
         )
     )
     return 0 if report["ok"] else 1

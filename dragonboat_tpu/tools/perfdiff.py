@@ -30,6 +30,10 @@ What is compared, per config present in BOTH records:
     records carry them — informational deltas only, never gated (more
     HBM may be the fix, fewer elections may be the workload); legacy
     records without the keys keep comparing untouched.
+  * `history_*` telemetry-sampler keys (sample count + total sample
+    cost), when BOTH records carry them — informational only, never
+    gated: they describe the observability overhead the run paid, not
+    the code under test.
 
 Honesty rule: a config stamped `scaled_down` (it ran fewer groups than
 its `nominal_groups` regime) is NOT comparable against a nominal run of
@@ -396,6 +400,21 @@ def compare_config(
             k: {"old": int(octr[k]), "new": int(nctr[k])}
             for k in sorted(set(octr) & set(nctr))
         }
+    # ---- telemetry-history sampler (INFORMATIONAL, never gated) -------
+    # the sampler runs live through the measured window; its sample
+    # count and total sample cost describe the observability overhead
+    # the run paid, not the code under test — surfaced for the operator,
+    # never in `reasons`. Pre-sampler records omit the section.
+    if all(
+        k in old and k in new
+        for k in ("history_samples_total", "history_sample_cost_seconds_total")
+    ):
+        hs: dict = {}
+        for k in ("history_samples_total", "history_errors_total",
+                  "history_sample_cost_seconds_total"):
+            o, n = float(old.get(k, 0)), float(new.get(k, 0))
+            hs[k] = {"old": o, "new": n, "delta_pct": _pct(o, n)}
+        out["history"] = hs
     if reasons:
         out["verdict"] = FAIL
     return out
@@ -486,6 +505,16 @@ def render(report: dict, old_name: str = "old", new_name: str = "new") -> str:
             lines.append(
                 f"    hbm (info): {b['old']:.0f} -> {b['new']:.0f} bytes,"
                 f" waste {w['old']:.2f} -> {w['new']:.2f}"
+            )
+        hs = c.get("history")
+        if hs:
+            s, cost = (
+                hs["history_samples_total"],
+                hs["history_sample_cost_seconds_total"],
+            )
+            lines.append(
+                f"    history (info): {s['old']:.0f} -> {s['new']:.0f} "
+                f"samples, cost {cost['old']:.4f}s -> {cost['new']:.4f}s"
             )
         for r in c.get("reasons", []):
             lines.append(f"    ! {r}")

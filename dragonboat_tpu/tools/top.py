@@ -21,7 +21,7 @@ are numpy-mirror folds on the vector engine and plain-int reads on the
 scalar one, so attaching raft-top to a live host costs ZERO device
 syncs and zero retraces.
 
-Two ways in:
+Three ways in:
 
   in-process   snap = collect_snapshot(hosts)        # {nid: NodeHost}
                print(render(snap))                    # or json.dump(snap)
@@ -31,7 +31,16 @@ Two ways in:
                    [--json] [--limit N] [--sort heat|gap|elections|ingest]
                    [--watch SECS]
 
-The CLI operates on snapshot FILES (bench and longhaul write them as
+  history      python -m dragonboat_tpu.tools.top --history HISTORY.ring
+               renders the LAST two samples of a telemetry history ring
+               (profile.HistorySampler) as the snapshot pair — windowed
+               ingest/churn rates from ONE artifact, no need for two
+               consecutive snapshot files — and appends raft-doctor's
+               top verdict as a one-line footer. Composes with --watch
+               (re-reads the ring each interval, so a live sampler
+               turns the console into a real-time view).
+
+The snapshot CLI operates on FILES (bench and longhaul write them as
 artifacts); `--watch` re-reads the file each interval and derives ingest
 rates from consecutive reads, so a writer refreshing the snapshot turns
 a frozen view into a live console without any IPC plumbing.
@@ -43,6 +52,8 @@ import json
 import sys
 import time
 from typing import Dict, List, Optional
+
+from .doctor import diagnose_data, load_history, top_verdict_line
 
 SNAPSHOT_SCHEMA = 1
 
@@ -190,8 +201,10 @@ def render(
     limit: int = 20,
     sort: str = "heat",
     out=None,
+    footer: Optional[str] = None,
 ) -> None:
-    """Print the console view: census/counter header + ranked lane table."""
+    """Print the console view: census/counter header + ranked lane table
+    (+ an optional footer line — the --history mode's doctor verdict)."""
     out = out or sys.stdout
     c = snap.get("census", {})
     ctr = snap.get("counters", {})
@@ -242,6 +255,8 @@ def render(
             f"{cc.get('replicate_rejects', 0):>5} "
             f"{r['heat']:>8.1f}\n"
         )
+    if footer:
+        out.write(footer + "\n")
 
 
 def load_snapshot(path: str) -> dict:
@@ -252,14 +267,89 @@ def load_snapshot(path: str) -> dict:
     return snap
 
 
+def history_to_snapshots(history: List[dict]):
+    """(snap, prev) raft-top snapshot views folded from history samples
+    (profile.HistorySampler): `snap` from each host's LAST sample,
+    `prev` from its second-last — the pair the heat/ingest rates need,
+    out of ONE artifact. Hosts with a single sample appear in `snap`
+    only (their lanes rank with rate 0); `prev` is None when no host
+    has two. Timestamps are the samples' monotonic `t` (rates only need
+    the difference). Lane rows keep the sampler's capped hot-lane table
+    — `lanes` here means "the lanes worth looking at", same contract as
+    the ring slot they came from."""
+    by: Dict[str, List[dict]] = {}
+    for s in history:
+        if s.get("event") != "history_sample":
+            continue
+        by.setdefault(str(s.get("host", "?")), []).append(s)
+    for samples in by.values():
+        samples.sort(key=lambda s: float(s.get("t", 0.0)))
+
+    def fold(idx: int) -> Optional[dict]:
+        lanes: List[dict] = []
+        counters: Dict[str, int] = {}
+        census: Dict[str, object] = {}
+        pressure: Dict[str, float] = {}
+        ts = 0.0
+        got = False
+        for host, samples in sorted(by.items()):
+            if len(samples) < abs(idx):
+                continue
+            s = samples[idx]
+            got = True
+            ts = max(ts, float(s.get("t", 0.0)))
+            for cid, row in sorted((s.get("lanes") or {}).items()):
+                r = {
+                    "host": host,
+                    "cluster_id": (
+                        int(cid) if str(cid).isdigit() else str(cid)
+                    ),
+                }
+                r.update(row)
+                r.setdefault("counters", {})
+                lanes.append(r)
+            for k, v in (s.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + int(v)
+            c = s.get("census") or {}
+            if int(c.get("hbm_bytes_total", 0)) >= int(
+                census.get("hbm_bytes_total", 0)
+            ):
+                census = dict(c)
+            p = s.get("pressure") or {}
+            pressure["inbox_occupancy"] = max(
+                pressure.get("inbox_occupancy", 0.0),
+                float(p.get("inbox_occupancy", 0.0)),
+            )
+            pressure["staged_backlog"] = pressure.get(
+                "staged_backlog", 0
+            ) + int(p.get("staged_backlog", 0))
+        if not got:
+            return None
+        return {
+            "schema": SNAPSHOT_SCHEMA,
+            "ts": ts,
+            "lanes": lanes,
+            "census": census,
+            "counters": counters,
+            "pressure": pressure,
+        }
+
+    return fold(-1), fold(-2)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dragonboat_tpu.tools.top",
         description=__doc__.splitlines()[0],
     )
-    ap.add_argument("snapshot",
+    ap.add_argument("snapshot", nargs="?", default=None,
                     help="snapshot JSON written by collect_snapshot "
                          "(bench/longhaul artifact)")
+    ap.add_argument("--history", default=None, metavar="RING",
+                    help="render from a telemetry history ring "
+                         "(profile.HistorySampler) instead of snapshot "
+                         "files: rates from the last two samples, "
+                         "raft-doctor's top verdict as a footer")
     ap.add_argument("--json", action="store_true",
                     help="emit the ranked snapshot as JSON instead of "
                          "the console table")
@@ -268,34 +358,56 @@ def main(argv=None) -> int:
     ap.add_argument("--sort", choices=_SORTS, default="heat",
                     help="ranking axis (default heat)")
     ap.add_argument("--watch", type=float, default=None, metavar="SECS",
-                    help="re-read the snapshot file each interval; "
-                         "ingest rates derive from consecutive reads")
+                    help="re-read the snapshot file (or history ring) "
+                         "each interval; ingest rates derive from "
+                         "consecutive reads")
     args = ap.parse_args(argv)
+    if (args.snapshot is None) == (args.history is None):
+        ap.error("give a snapshot file OR --history RING")
+
+    def load_view():
+        """(snap, prev, footer) for one render pass."""
+        if args.history is None:
+            return load_snapshot(args.snapshot), None, None
+        history = load_history(args.history)
+        snap, prev = history_to_snapshots(history)
+        if snap is None:
+            raise ValueError(f"{args.history}: no history samples")
+        footer = top_verdict_line(diagnose_data(history))
+        return snap, prev, footer
+
     try:
-        snap = load_snapshot(args.snapshot)
+        snap, prev, footer = load_view()
     except (OSError, ValueError) as e:
         sys.stderr.write(f"error: {e}\n")
         return 2
     if args.watch is None:
         if args.json:
             json.dump(
-                {**snap, "lanes": rank_lanes(snap, sort=args.sort)},
+                {**snap, "lanes": rank_lanes(snap, prev, sort=args.sort)},
                 sys.stdout, sort_keys=True,
             )
             sys.stdout.write("\n")
         else:
-            render(snap, limit=args.limit, sort=args.sort)
+            render(
+                snap, prev=prev, limit=args.limit, sort=args.sort,
+                footer=footer,
+            )
         return 0
-    prev = None
+    file_prev = None  # snapshot-file mode: rates from consecutive reads
     try:
         while True:
-            render(snap, prev=prev, limit=args.limit, sort=args.sort)
+            render(
+                snap,
+                prev=prev if args.history is not None else file_prev,
+                limit=args.limit, sort=args.sort, footer=footer,
+            )
             sys.stdout.write("\n")
             sys.stdout.flush()
             time.sleep(max(args.watch, 0.05))
-            prev = snap
+            file_prev = snap
             try:
-                snap = load_snapshot(args.snapshot)
+                snap, prev, footer = load_view()
             except (OSError, ValueError):
                 pass  # writer mid-rotation: keep the last good view
     except KeyboardInterrupt:

@@ -397,7 +397,16 @@ def read_mmap_ring(path: str) -> Tuple[dict, List[dict]]:
             continue  # torn slot: the write this kill interrupted
         slots.append((seq, d))
     slots.sort(key=lambda s: s[0])
-    meta = {"mono_offset": mono_offset, "source": os.path.basename(path)}
+    # capacity/slot_size ride the meta so readers (tools.doctor's ring
+    # report, tools.top --history) can say how much timeline the ring
+    # COULD hold vs what it did — a full ring means older samples were
+    # overwritten, an honesty caveat every diagnosis should carry
+    meta = {
+        "mono_offset": mono_offset,
+        "source": os.path.basename(path),
+        "capacity": int(capacity),
+        "slot_size": int(slot_size),
+    }
     return meta, [d for _, d in slots]
 
 

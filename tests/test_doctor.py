@@ -1,0 +1,597 @@
+"""tools.doctor tests: the stall-diagnosis rule engine and its CLI.
+
+Three planes of coverage, mirroring how the doctor is actually used:
+
+* synthetic rule tests — hand-built history samples drive every verdict
+  kind in the taxonomy through ``diagnose_data`` and assert BOTH that
+  the expected verdict fires and that the others stay quiet (a doctor
+  that diagnoses everything diagnoses nothing);
+* live seeded single-fault scenarios — a real fault (partition, fsync
+  stall via the FaultPlane's WAL wrapper, tick-clock step jump,
+  admission overload) injected into live NodeHosts, sampled with the
+  same ``sample_host`` the history ring uses, and diagnosed;
+* the checked-in failure-bundle fixture (tests/data/doctor_bundle)
+  rendered through the real CLI subprocess, pinning the bundle loader
+  and the report schema an operator actually sees.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dragonboat_tpu.config import Config, EngineConfig, NodeHostConfig
+from dragonboat_tpu.faults import ClockPlane, FaultPlane, FaultSpec
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.profile import sample_host
+from dragonboat_tpu.serving import AdmissionConfig, ErrOverloaded, TenantSpec
+from dragonboat_tpu.statemachine import IStateMachine, Result
+from dragonboat_tpu.storage import ShardedLogDB
+from dragonboat_tpu.storage.kv import WalKV
+from dragonboat_tpu.tools.doctor import (
+    diagnose,
+    diagnose_data,
+    diagnosis_report,
+    load_bundle,
+    top_verdict_line,
+)
+from dragonboat_tpu.transport.loopback import _Registry, loopback_factory
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(os.path.dirname(__file__), "data", "doctor_bundle")
+
+CLUSTER = 1
+
+# the seeded-fault kinds the live scenarios must discriminate between:
+# each scenario asserts its own kind fired and NONE of the other four
+FAULT_KINDS = frozenset({
+    "no_quorum_partition",
+    "election_churn",
+    "wal_fsync_stall",
+    "clock_anomaly",
+    "admission_shed_storm",
+})
+
+
+# ------------------------------------------------------- sample builders
+def lane(leader=1, gap=0, started=0, won=0, node=1, term=2):
+    """One capped-lane-table row shaped like profile.sample_host emits:
+    lane_stats fields + the hot counters subdict doctor's deltas read."""
+    return {
+        "node_id": node,
+        "leader_id": leader,
+        "term": term,
+        "commit_gap": gap,
+        "counters": {"elections_started": started, "elections_won": won},
+    }
+
+
+def mk(host, t, lanes=None, **over):
+    """A minimal-but-complete history sample: every plane present and
+    quiet, so a test overrides exactly the plane its rule reads."""
+    s = {
+        "event": "history_sample",
+        "schema": 1,
+        "t": float(t),
+        "host": host,
+        "cluster": 0,
+        "lanes": dict(lanes or {}),
+        "lanes_total": len(lanes or {}),
+        "lanes_dropped": 0,
+        "counters": {},
+        "pressure": {},
+        "lease": {"local": 0, "fallback": 0},
+        "census": {
+            "hbm_bytes_total": 0, "hbm_waste_ratio": 0.0, "lanes_active": 0,
+        },
+        "fairness_gap_s": 0.0,
+        "clock_anomalies": 0,
+        "wal": {
+            "ewma_s": 0.0, "last_s": 0.0, "last_wave_s": 0.0,
+            "inflight": 0, "barriers": 0,
+        },
+        "serving": {
+            "admitted": 0, "shed": 0, "queue_depth": 0, "saturation": 0.0,
+        },
+        "migrations": {"started": 0, "completed": 0, "aborted": 0,
+                       "active": 0},
+    }
+    s.update(over)
+    return s
+
+
+def kinds(verdicts):
+    return [v.kind for v in verdicts]
+
+
+# ------------------------------------------------- synthetic rule tests
+def test_rule_healthy_idle_is_the_empty_verdict():
+    hist = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 0.5, {"1": lane()}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["healthy_idle"]
+    assert vs[0].severity == 0
+    assert vs[0].hosts == ["a"]
+    assert vs[0].evidence["samples"] == 2
+
+
+def test_rule_no_quorum_partition():
+    hist = [
+        mk("a", 0.0, {"1": lane(leader=0, started=0)}),
+        mk("b", 0.0, {"1": lane(leader=0, started=0)}),
+        mk("a", 1.0, {"1": lane(leader=0, started=3)}),
+        mk("b", 1.0, {"1": lane(leader=0, started=2)}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["no_quorum_partition"]
+    v = vs[0]
+    assert v.lanes == ["1"]
+    assert v.evidence["elections_started_delta"] == 5
+    assert v.evidence["elections_won_delta"] == 0
+    assert sorted(v.evidence["leaderless_hosts"]) == ["a", "b"]
+
+
+def test_rule_election_churn_needs_wins_not_just_campaigns():
+    # three WON elections in the window: flapping leadership, not a
+    # partition (somebody keeps winning) — and a leader is present at
+    # the window's end, so the no-quorum rule must stay quiet
+    hist = [
+        mk("a", 0.0, {"1": lane(leader=1, started=0, won=0)}),
+        mk("a", 1.0, {"1": lane(leader=2, started=4, won=3)}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["election_churn"]
+    assert vs[0].evidence["elections_won_delta"] == 3
+    # two wins is a normal failover, not churn
+    calm = [
+        mk("a", 0.0, {"1": lane(leader=1, won=0)}),
+        mk("a", 1.0, {"1": lane(leader=2, started=2, won=2)}),
+    ]
+    assert kinds(diagnose_data(calm)) == ["healthy_idle"]
+
+
+def test_rule_wal_fsync_stall():
+    wal = {"ewma_s": 0.18, "last_s": 0.2, "last_wave_s": 0.2,
+           "inflight": 1, "barriers": 40}
+    hist = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 1.0, {"1": lane()}, wal=wal),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["wal_fsync_stall"]
+    assert vs[0].evidence["fsync_ewma_max_s"] == pytest.approx(0.18)
+    assert vs[0].evidence["barriers_delta"] == 40
+
+
+def test_rule_clock_anomaly_delta_and_single_sample_forms():
+    hist = [
+        mk("a", 0.0, {"1": lane()}, clock_anomalies=1),
+        mk("a", 1.0, {"1": lane()}, clock_anomalies=3),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["clock_anomaly"]
+    assert vs[0].evidence["clock_anomalies_delta"] == 2
+    # a single-sample series (crashed ring tail) falls back to the
+    # cumulative count — one sample of evidence beats none
+    solo = [mk("a", 0.0, {"1": lane()}, clock_anomalies=4)]
+    vs = diagnose_data(solo)
+    assert "clock_anomaly" in kinds(vs)
+    assert vs[kinds(vs).index("clock_anomaly")].evidence[
+        "clock_anomalies_delta"] == 4
+
+
+def test_rule_admission_shed_storm():
+    hist = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 1.0, {"1": lane()},
+           serving={"admitted": 3, "shed": 9, "queue_depth": 2,
+                    "saturation": 0.8}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["admission_shed_storm"]
+    ev = vs[0].evidence
+    assert ev["shed_delta"] == 9
+    assert ev["admitted_delta"] == 3
+    assert ev["saturation_max"] == pytest.approx(0.8)
+    # four sheds is backpressure doing its job, not a storm
+    calm = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 1.0, {"1": lane()},
+           serving={"admitted": 9, "shed": 4, "queue_depth": 0,
+                    "saturation": 0.2}),
+    ]
+    assert kinds(diagnose_data(calm)) == ["healthy_idle"]
+
+
+def test_rule_lease_fallback_storm_subsumed_by_clock_anomaly():
+    stormy = dict(lease={"local": 1, "fallback": 8})
+    hist = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 1.0, {"1": lane()}, **stormy),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["lease_fallback_storm"]
+    assert vs[0].evidence["lease_fallback_delta"] == 8
+    assert vs[0].evidence["lease_local_delta"] == 1
+    # the SAME fallback storm with a clock fault in the window is the
+    # lease plane working as designed: clock_anomaly alone must fire
+    explained = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 1.0, {"1": lane()}, clock_anomalies=1, **stormy),
+    ]
+    vs = diagnose_data(explained)
+    assert "clock_anomaly" in kinds(vs)
+    assert "lease_fallback_storm" not in kinds(vs)
+
+
+def test_rule_migration_wedged_requires_zero_progress():
+    hist = [
+        mk("a", 0.0, {"1": lane()},
+           migrations={"started": 2, "completed": 1, "aborted": 0,
+                       "active": 1}),
+        mk("a", 1.0, {"1": lane()},
+           migrations={"started": 2, "completed": 1, "aborted": 0,
+                       "active": 1}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["migration_wedged"]
+    assert vs[0].evidence["migrations_active"] == 1
+    # one completion in the window = progress, however slow
+    moving = [
+        mk("a", 0.0, {"1": lane()},
+           migrations={"started": 2, "completed": 1, "aborted": 0,
+                       "active": 1}),
+        mk("a", 1.0, {"1": lane()},
+           migrations={"started": 2, "completed": 2, "aborted": 0,
+                       "active": 1}),
+    ]
+    assert kinds(diagnose_data(moving)) == ["healthy_idle"]
+
+
+def test_rule_lane_leak_needs_monotone_growth():
+    hist = [
+        mk("a", 0.0, {}, lanes_total=2),
+        mk("a", 0.5, {}, lanes_total=7),
+        mk("a", 1.0, {}, lanes_total=12),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["lane_leak"]
+    assert vs[0].evidence["lanes_first"] == 2
+    assert vs[0].evidence["lanes_last"] == 12
+    # a dip in the middle means churn is REAPING — growth alone is fine
+    churny = [
+        mk("a", 0.0, {}, lanes_total=2),
+        mk("a", 0.5, {}, lanes_total=14),
+        mk("a", 1.0, {}, lanes_total=12),
+    ]
+    assert kinds(diagnose_data(churny)) == ["healthy_idle"]
+
+
+def test_rule_snapshot_parked_remote_needs_flight_corroboration():
+    frozen = [
+        mk("a", 0.0, {"7": lane(leader=1, gap=6)}),
+        mk("a", 1.0, {"7": lane(leader=1, gap=6)}),
+    ]
+    # a frozen gap with NO transfer evidence stays undiagnosed: the
+    # history plane alone cannot tell "parked" from "just slow"
+    assert kinds(diagnose_data(frozen)) == ["healthy_idle"]
+    flight = [{"event": "snapshot_stream_aborted", "cluster": 7, "t": 0.4}]
+    vs = diagnose_data(frozen, flight=flight)
+    assert kinds(vs) == ["snapshot_parked_remote"]
+    v = vs[0]
+    assert v.lanes == ["7"]
+    assert v.evidence["commit_gap_frozen"] == 6
+    assert v.evidence["snapshot_events"]["snapshot_stream_aborted"] == 1
+    # requested-but-never-installed is the other parked shape
+    flight2 = [
+        {"event": "snapshot_requested", "cluster": 7, "t": 0.1},
+    ]
+    assert kinds(diagnose_data(frozen, flight=flight2)) == [
+        "snapshot_parked_remote"
+    ]
+
+
+def test_verdicts_rank_most_severe_first_and_footer_line():
+    hist = [
+        mk("a", 0.0, {"1": lane(leader=0)}),
+        mk("a", 1.0, {"1": lane(leader=0, started=4)},
+           serving={"admitted": 0, "shed": 9, "queue_depth": 0,
+                    "saturation": 0.9}),
+    ]
+    vs = diagnose_data(hist)
+    assert kinds(vs) == ["no_quorum_partition", "admission_shed_storm"]
+    assert vs[0].severity > vs[1].severity
+    line = top_verdict_line(vs)
+    assert line == "doctor: no_quorum_partition sev=95 hosts=a lanes=1"
+    assert top_verdict_line([]) == "doctor: (no verdicts)"
+
+
+def test_diagnosis_report_schema():
+    hist = [
+        mk("a", 0.0, {"1": lane()}),
+        mk("a", 0.75, {"1": lane()}),
+    ]
+    rep = diagnosis_report(hist, source="round-001")
+    assert rep["schema"] == 1
+    assert rep["source"] == "round-001"
+    assert rep["samples"] == 2
+    assert rep["hosts"] == ["a"]
+    assert rep["window_s"] == pytest.approx(0.75)
+    assert [v["kind"] for v in rep["verdicts"]] == ["healthy_idle"]
+    json.dumps(rep)  # the bundle artifact must be JSON-serializable
+
+
+# ------------------------------------------------- live fault scenarios
+class KV(IStateMachine):
+    def __init__(self):
+        self.d = {}
+
+    def update(self, data):
+        k, v = data.decode().split("=", 1)
+        self.d[k] = v
+        return Result(value=len(self.d))
+
+    def lookup(self, q):
+        return self.d.get(q)
+
+    def save_snapshot(self, w, files, done):
+        w.write(json.dumps(self.d).encode())
+
+    def recover_from_snapshot(self, r, files, done):
+        self.d = json.loads(r.read().decode())
+
+
+def _mk_host(nid, reg, tmp, logdb_factory=None):
+    cfg = NodeHostConfig(
+        deployment_id=7,
+        rtt_millisecond=5,
+        nodehost_dir=os.path.join(tmp, f"h{nid}"),
+        raft_address=f"d{nid}:1",
+        raft_rpc_factory=lambda listen, reg=reg: loopback_factory(
+            listen, reg
+        ),
+        logdb_factory=logdb_factory,
+        engine=EngineConfig(kind="scalar", max_groups=4, max_peers=4),
+    )
+    return NodeHost(cfg)
+
+
+def _group_cfg(nid):
+    return Config(
+        cluster_id=CLUSTER, node_id=nid, election_rtt=10, heartbeat_rtt=2
+    )
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _assert_single_fault(verdicts, expected):
+    """The discrimination contract: the seeded fault's kind fired, the
+    other seeded-fault kinds did not, and the fleet is not 'healthy'."""
+    ks = set(kinds(verdicts))
+    assert expected in ks, f"{expected} missing from {sorted(ks)}"
+    assert not (ks & (FAULT_KINDS - {expected})), (
+        f"cross-diagnosis: {sorted(ks & (FAULT_KINDS - {expected}))}"
+    )
+    assert "healthy_idle" not in ks
+
+
+def test_live_healthy_host_diagnoses_idle(tmp_path):
+    reg = _Registry()
+    nh = _mk_host(1, reg, str(tmp_path))
+    try:
+        nh.start_cluster({1: "d1:1"}, False, lambda *_a: KV(), _group_cfg(1))
+        assert _wait(lambda: nh.get_leader_id(CLUSTER)[1])
+        for i in range(4):
+            nh.sync_propose(
+                nh.get_noop_session(CLUSTER), b"k=%d" % i, timeout_s=10.0
+            )
+        vs = diagnose({1: nh}, window_s=0.4, interval_s=0.1, flight=[])
+        assert kinds(vs) == ["healthy_idle"]
+        assert vs[0].hosts == ["d1:1"]
+    finally:
+        nh.stop()
+
+
+def test_live_partition_diagnoses_no_quorum(tmp_path):
+    reg = _Registry()
+    hosts = {n: _mk_host(n, reg, str(tmp_path)) for n in (1, 2, 3)}
+    members = {n: f"d{n}:1" for n in (1, 2, 3)}
+    try:
+        for n, nh in hosts.items():
+            nh.start_cluster(members, False, lambda *_a: KV(), _group_cfg(n))
+        assert _wait(
+            lambda: any(
+                nh.get_leader_id(CLUSTER)[1] for nh in hosts.values()
+            )
+        )
+        for nh in hosts.values():
+            nh.set_partitioned(True)
+        # past a few election RTTs: every island has started (and lost)
+        # at least one campaign by the time the window opens
+        time.sleep(0.8)
+        s1 = [sample_host(nh) for nh in hosts.values()]
+        time.sleep(1.0)
+        s2 = [sample_host(nh) for nh in hosts.values()]
+        vs = diagnose_data(s1 + s2, flight=[])
+        _assert_single_fault(vs, "no_quorum_partition")
+        v = vs[kinds(vs).index("no_quorum_partition")]
+        assert v.evidence["elections_started_delta"] > 0
+        assert v.evidence["elections_won_delta"] == 0
+        assert v.lanes == [str(CLUSTER)]
+    finally:
+        for nh in hosts.values():
+            nh.stop()
+
+
+def test_live_fsync_stall_diagnoses_wal(tmp_path):
+    fp = FaultPlane(0xD0C)
+
+    def logdb_factory(d):
+        return ShardedLogDB(
+            os.path.join(d, "logdb"),
+            kv_factory=fp.kv_factory("fsync:doc", WalKV),
+        )
+
+    reg = _Registry()
+    nh = _mk_host(1, reg, str(tmp_path), logdb_factory=logdb_factory)
+    try:
+        nh.start_cluster({1: "d1:1"}, False, lambda *_a: KV(), _group_cfg(1))
+        assert _wait(lambda: nh.get_leader_id(CLUSTER)[1])
+        nh.sync_propose(nh.get_noop_session(CLUSTER), b"w=0", timeout_s=10.0)
+        s1 = sample_host(nh)
+        # every barrier now stalls 80ms: the ewma (alpha .2) crosses the
+        # 50ms stall threshold after ~5 barriers
+        fp.set_spec(FaultSpec(fsync_stall=1.0, fsync_stall_s=(0.08, 0.08)))
+        for i in range(10):
+            nh.sync_propose(
+                nh.get_noop_session(CLUSTER), b"k=%d" % i, timeout_s=30.0
+            )
+        s2 = sample_host(nh)
+        assert s2["wal"]["ewma_s"] > s1["wal"]["ewma_s"]
+        vs = diagnose_data([s1, s2], flight=[])
+        _assert_single_fault(vs, "wal_fsync_stall")
+        v = vs[kinds(vs).index("wal_fsync_stall")]
+        assert v.evidence["fsync_ewma_max_s"] >= 0.05
+        assert v.evidence["barriers_delta"] > 0
+    finally:
+        fp.set_spec(FaultSpec())
+        nh.stop()
+
+
+def test_live_clock_jump_diagnoses_clock_anomaly(tmp_path):
+    reg = _Registry()
+    cp = ClockPlane(FaultPlane(0xC10))
+    nh = _mk_host(1, reg, str(tmp_path))
+    try:
+        nh.set_tick_clock(cp.clock_fn("h1"))
+        nh.start_cluster({1: "d1:1"}, False, lambda *_a: KV(), _group_cfg(1))
+        assert _wait(lambda: nh.get_leader_id(CLUSTER)[1])
+        nh.sync_propose(nh.get_noop_session(CLUSTER), b"k=v", timeout_s=10.0)
+        s1 = sample_host(nh)
+        assert s1["clock_anomalies"] == 0
+        cp.step_jump("h1", 5.0)
+        assert _wait(lambda: nh.clock_anomalies() >= 1, timeout=5.0)
+        s2 = sample_host(nh)
+        vs = diagnose_data([s1, s2], flight=[])
+        _assert_single_fault(vs, "clock_anomaly")
+        v = vs[kinds(vs).index("clock_anomaly")]
+        assert v.evidence["clock_anomalies_delta"] >= 1
+    finally:
+        nh.stop()
+
+
+def test_live_overload_storm_diagnoses_shed_storm(tmp_path):
+    reg = _Registry()
+    nh = _mk_host(1, reg, str(tmp_path))
+    try:
+        nh.start_cluster({1: "d1:1"}, False, lambda *_a: KV(), _group_cfg(1))
+        assert _wait(lambda: nh.get_leader_id(CLUSTER)[1])
+        # a starved bucket: ~2 admits then synchronous typed sheds
+        front = nh.serving_front(
+            admission=AdmissionConfig(
+                default=TenantSpec(rate=1.0, burst=2.0)
+            )
+        )
+        s1 = sample_host(nh)
+        tickets, shed = [], 0
+        for i in range(30):
+            try:
+                tickets.append(
+                    front.propose(11, CLUSTER, b"s=%d" % i, 10.0)
+                )
+            except ErrOverloaded:
+                shed += 1
+        assert shed >= 5
+        for t in tickets:
+            t.wait()
+        s2 = sample_host(nh)
+        vs = diagnose_data([s1, s2], flight=[])
+        _assert_single_fault(vs, "admission_shed_storm")
+        v = vs[kinds(vs).index("admission_shed_storm")]
+        assert v.evidence["shed_delta"] >= 5
+    finally:
+        nh.stop()
+
+
+# --------------------------------------------------- bundle fixture/CLI
+def test_fixture_bundle_loads_both_planes():
+    bundle = load_bundle(_FIXTURE)
+    assert bundle["source"] == "doctor_bundle"
+    assert len(bundle["history"]) == 3
+    assert all(
+        s["event"] == "history_sample" for s in bundle["history"]
+    )
+    assert any(
+        e["event"].startswith("snapshot_") for e in bundle["flight"]
+    )
+    vs = diagnose_data(bundle["history"], flight=bundle["flight"])
+    assert kinds(vs) == ["snapshot_parked_remote"]
+
+
+def test_doctor_cli_renders_fixture_bundle():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dragonboat_tpu.tools.doctor", _FIXTURE],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "raft-doctor:" in out
+    assert "snapshot_parked_remote" in out
+    assert "commit_gap_frozen=6" in out
+    assert "hint:" in out
+
+
+def test_doctor_cli_json_mode():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dragonboat_tpu.tools.doctor",
+            _FIXTURE, "--json",
+        ],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["schema"] == 1
+    assert rep["source"] == "doctor_bundle"
+    assert rep["verdicts"][0]["kind"] == "snapshot_parked_remote"
+    assert rep["verdicts"][0]["severity"] == 70
+    assert rep["verdicts"][0]["evidence"]["commit_gap_frozen"] == 6
+
+
+def test_doctor_cli_rejects_garbage_input(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dragonboat_tpu.tools.doctor",
+            os.path.join(str(tmp_path), "nope.ring"),
+        ],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "doctor:" in proc.stderr
+
+
+def test_top_history_renders_fixture_with_doctor_footer():
+    hist = os.path.join(_FIXTURE, "history.jsonl")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dragonboat_tpu.tools.top",
+            "--history", hist,
+        ],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    # the fixture's frozen gap needs flight corroboration to diagnose —
+    # the history-only footer stays honest and reports idle
+    assert "doctor: healthy_idle" in proc.stdout
+    assert "fix1:1" in proc.stdout

@@ -83,6 +83,8 @@ def test_longhaul_failure_bundle_sweeps_rings_and_prints_replay(
             seed=seed,
             ring=False,
             inject_failure=True,
+            reuse_out=True,  # the planted ring must survive the guard
+            triage=False,  # the triage replay has its own test
         )
     )
     assert not report["ok"]
@@ -91,6 +93,17 @@ def test_longhaul_failure_bundle_sweeps_rings_and_prints_replay(
     manifest = json.load(open(os.path.join(r.bundle, "manifest.json")))
     assert manifest["verdicts"]["injected_failure"] is False
     assert any(p.endswith("crashed.ring") for p in manifest["swept_artifacts"])
+    # the bundle carries the telemetry history ring + doctor diagnosis
+    from dragonboat_tpu.profile import read_history
+
+    _meta, samples = read_history(os.path.join(r.bundle, "history.ring"))
+    assert samples and all(s["event"] == "history_sample" for s in samples)
+    diag = json.load(open(os.path.join(r.bundle, "diagnosis.json")))
+    assert diag["schema"] == 1 and diag["samples"] == len(samples)
+    kinds = [v["kind"] for v in diag["verdicts"]]
+    assert kinds, diag
+    assert r.diagnosis == kinds[0]
+    assert manifest["doctor_verdict"] == kinds[0]
     merged = os.path.join(r.bundle, "merged_timeline.jsonl")
     events = [json.loads(ln) for ln in open(merged)]
     assert any(e.get("event") == "planted_marker" for e in events)
@@ -100,6 +113,102 @@ def test_longhaul_failure_bundle_sweeps_rings_and_prints_replay(
     assert f"--seed 0x{seed:X} --rounds 1" in r.replay
     out = capsys.readouterr().out
     assert "replay: CHAOS_SEED=0x" in out and "FAILED" in out
+
+
+def test_longhaul_out_dir_guard_rotates_stale_runs(tmp_path, capsys):
+    """A populated --out dir is rotated to <out>.prev before any round
+    starts: reusing stale h<N> dirs makes restarted hosts replay old WAL
+    state and fail lincheck spuriously (the flake this guard kills)."""
+    from dragonboat_tpu.tools.longhaul import _prepare_out_dir
+
+    out = str(tmp_path / "run")
+    stale = os.path.join(out, "round-001-seed-0xDEAD", "h1")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "wal.bin"), "w") as f:
+        f.write("stale")
+    # unit: non-empty dir rotates (replacing an older .prev), empty and
+    # reuse=True do not
+    assert _prepare_out_dir(out) is True
+    assert os.listdir(out) == []
+    assert os.path.isdir(os.path.join(out + ".prev", "round-001-seed-0xDEAD"))
+    assert _prepare_out_dir(out) is False  # now empty: no rotation
+    assert _prepare_out_dir(out + ".prev", reuse=True) is False
+    assert os.path.exists(os.path.join(stale.replace(out, out + ".prev"),
+                                       "wal.bin"))
+    # runner level: a zero-budget run over a dirty dir stamps the
+    # rotation in the header and the report
+    os.makedirs(os.path.join(out, "leftover"))
+    report = run_longhaul(
+        Options(budget_s=0.0, out_dir=out, seed=1, ring=False)
+    )
+    assert report["out_dir_rotated"] is True
+    assert not os.path.exists(os.path.join(out, "leftover"))
+    assert os.path.isdir(os.path.join(out + ".prev", "leftover"))
+    assert "(rotated stale run to .prev)" in capsys.readouterr().out
+
+
+def test_longhaul_triage_tags_injected_failure_deterministic(tmp_path):
+    """Failure triage: an injected failure is a new signature, gets ONE
+    same-seed replay in a fresh `-triage` dir, and — since the replay
+    fails the same verdict — lands in triage.json as DETERMINISTIC."""
+    out = str(tmp_path / "run")
+    report = run_longhaul(
+        Options(
+            budget_s=30.0,
+            rounds_max=1,
+            round_s=3.0,
+            engine="scalar",
+            out_dir=out,
+            seed=0xABC,
+            ring=False,
+            inject_failure=True,
+        )
+    )
+    assert not report["ok"]
+    assert len(report["triage"]) == 1
+    entry = report["triage"][0]
+    assert entry["tag"] == "DETERMINISTIC"
+    assert "injected_failure" in entry["verdicts"]
+    assert entry["rounds"] == [1] and entry["seed"] == "0xABC"
+    assert report["rounds"][0].triage == "DETERMINISTIC"
+    # the replay ran in its own dir (stale h<N> reuse is poison)
+    assert os.path.isdir(os.path.join(out, "round-001-seed-0xABC-triage"))
+    ledger = json.load(open(report["triage_path"]))
+    assert ledger["schema"] == 1
+    assert [e["signature"] for e in ledger["entries"]] == [entry["signature"]]
+
+
+def test_longhaul_triage_signature_dedupes_repeat_failures(tmp_path):
+    """Ledger mechanics without running rounds: equal (failed-verdicts,
+    diagnosis) pairs share a signature and later rounds join the entry
+    with NO extra replay; different pairs get distinct signatures."""
+    from dragonboat_tpu.tools.longhaul import (
+        RoundResult, _triage_round, _triage_signature,
+    )
+
+    a1 = RoundResult(1, 7, verdicts={"lincheck": False, "x": True},
+                     diagnosis="wal_fsync_stall")
+    a2 = RoundResult(5, 9, verdicts={"lincheck": False, "x": True},
+                     diagnosis="wal_fsync_stall")
+    b = RoundResult(2, 7, verdicts={"lincheck": False, "x": False},
+                    diagnosis="wal_fsync_stall")
+    c = RoundResult(3, 7, verdicts={"lincheck": False, "x": True},
+                    diagnosis="election_churn")
+    assert _triage_signature(a1) == _triage_signature(a2)
+    assert len({_triage_signature(r) for r in (a1, b, c)}) == 3
+    ledger = {
+        _triage_signature(a1): {
+            "signature": _triage_signature(a1),
+            "verdicts": ["lincheck"], "diagnosis": "wal_fsync_stall",
+            "rounds": [1], "seed": "0x7", "tag": "LOAD_SENSITIVE",
+        }
+    }
+    # a known signature joins the entry; no _Round replay fires (it
+    # would blow up on this bogus Options out dir if it did)
+    _triage_round(a2, 9, Options(out_dir=str(tmp_path / "nope")), ledger)
+    entry = ledger[_triage_signature(a2)]
+    assert entry["rounds"] == [1, 5]
+    assert a2.triage == "LOAD_SENSITIVE"
 
 
 def test_timeline_sweep_flag_merges_run_dir(tmp_path):

@@ -9,6 +9,7 @@ regression net for the `_bucket`/`_sum`/`_count` contract: no duplicate
 """
 import io
 import json
+import os
 import re
 import time
 
@@ -511,6 +512,46 @@ def test_census_and_counter_gauges_in_exposition(single_host):
         "dragonboat_tpu_engine_counter_elections_started",
     ):
         assert types[name] == "gauge"
+
+
+def test_history_gauges_in_exposition(single_host):
+    """ISSUE 19: the engine_history_* sampler gauges are ALWAYS present
+    (zero-filled with no sampler) and carry live counts once the host's
+    HistorySampler runs, flowing through _export_health_gauges into a
+    conformant Prometheus exposition."""
+    nh = single_host
+    # no sampler yet: gauges exist and read zero (stable dashboards)
+    nh._export_health_gauges()
+    m = nh.metrics
+    assert m.gauge_value("engine_history_samples_total", (0, 0)) == 0.0
+    assert m.gauge_value("engine_history_interval_seconds", (0, 0)) == 0.0
+    nh.start_history(interval_s=0.02)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if nh._history.stats()["samples_total"] >= 2:
+                break
+            time.sleep(0.02)
+        nh._export_health_gauges()
+        assert m.gauge_value("engine_history_samples_total", (0, 0)) >= 2.0
+        assert m.gauge_value("engine_history_errors_total", (0, 0)) == 0.0
+        assert m.gauge_value("engine_history_interval_seconds", (0, 0)) > 0.0
+    finally:
+        nh.stop_history()
+    out = io.StringIO()
+    nh.write_health_metrics(out)
+    text = out.getvalue()
+    assert "dragonboat_tpu_engine_history_samples_total" in text
+    types, _samples = _parse_exposition(
+        "\n".join(ln for ln in text.splitlines() if "_history_" in ln)
+    )
+    assert types["dragonboat_tpu_engine_history_samples_total"] == "gauge"
+    # the ring landed next to the host's WAL dir and reads back
+    from dragonboat_tpu.profile import read_history
+
+    ring = os.path.join(nh._dir, "history.ring")
+    _meta, hist_samples = read_history(ring)
+    assert hist_samples and hist_samples[-1]["host"] == "obs1:1"
 
 
 def test_scalar_engine_counter_and_census_parity(tmp_path):

@@ -342,6 +342,63 @@ def test_census_and_counters_add_zero_syncs(vec_host):
 
 
 @pytest.mark.perf
+def test_history_sampler_adds_zero_syncs_and_zero_retraces(vec_host, tmp_path):
+    """Acceptance (ISSUE 19 tentpole): a LIVE HistorySampler ticking at
+    a hot cadence over a vector host adds ZERO out-of-seam device syncs
+    and zero steady-state retraces — every snapshotted source is a
+    zero-sync stat export (decode-maintained numpy mirrors / plain
+    ints) and the ring write is pure host-side json+mmap."""
+    from dragonboat_tpu.profile import (
+        HISTORY_STATS_KEYS,
+        HistorySampler,
+        read_history,
+    )
+
+    nh = vec_host
+    sa = sync_audit().install()
+    cw = compile_watch().install()
+    ring = str(tmp_path / "hist" / "history.ring")
+    os.makedirs(os.path.dirname(ring))
+    sampler = None
+    try:
+        sess = nh.get_noop_session(1)
+        for i in range(4):
+            nh.sync_propose(sess, f"w{i}=v".encode(), timeout_s=10.0)
+        pkg_mark = dict(sa.out_of_seam_in_package())
+        compile_mark = cw.snapshot()
+        sampler = HistorySampler(ring, {0: nh}, interval_s=0.02).start()
+        try:
+            for i in range(8):
+                nh.sync_propose(sess, f"h{i}=v".encode(), timeout_s=10.0)
+            time.sleep(0.1)  # several sampler ticks land mid-traffic
+        finally:
+            sampler.stop()
+        new_pkg = {
+            s: n for s, n in sa.out_of_seam_in_package().items()
+            if n > pkg_mark.get(s, 0)
+        }
+        assert not new_pkg, f"history sampling synced the device at {new_pkg}"
+        d = diff_compiles(compile_mark, cw.snapshot())
+        assert d["total"] == 0, f"history sampling retraced: {d}"
+    finally:
+        sa.uninstall()
+    st = sampler.stats()
+    assert list(st) == list(HISTORY_STATS_KEYS)
+    assert st["samples_total"] >= 2 and st["errors_total"] == 0
+    _meta, samples = read_history(ring)
+    assert len(samples) == st["samples_total"]
+    last = samples[-1]
+    assert last["event"] == "history_sample" and last["schema"] == 1
+    assert last["host"] == "perf1:1"
+    lane = last["lanes"]["1"]  # json object keys stringify
+    assert lane["leader_id"] == 1 and lane["commit_gap"] >= 0
+    assert lane["counters"]["commit_advances"] >= 8
+    assert last["counters"]["elections_won"] >= 1
+    assert last["census"]["hbm_bytes_total"] > 0
+    assert last.get("errors", []) == []
+
+
+@pytest.mark.perf
 def test_bench_attribution_fold_schema():
     """Acceptance: every bench config JSON always contains
     phase_breakdown (ALL canonical phase keys, zero when the phase never
@@ -376,6 +433,32 @@ def test_bench_census_fold_schema():
     assert r["hbm_waste_ratio"] == 0.0
     assert set(r["counters"]) == set(CTR_NAMES)
     assert all(v == 0 for v in r["counters"].values())
+
+
+@pytest.mark.perf
+def test_bench_history_fold_schema(tmp_path):
+    """Acceptance (ISSUE 19): every bench config JSON always carries the
+    history_* sampler keys — zero-filled when the sampler never started
+    (bring-up-failed path) so perfdiff's informational history section
+    reads a stable schema; a live sampler reports its real counts."""
+    import bench
+    from dragonboat_tpu.profile import HISTORY_STATS_KEYS
+
+    r = bench._history_report(None)
+    assert set(r) == {f"history_{k}" for k in HISTORY_STATS_KEYS}
+    assert r["history_samples_total"] == 0
+    assert r["history_errors_total"] == 0
+    assert r["history_sample_cost_seconds_total"] == 0.0
+    assert r["history_interval_seconds"] == 0.0
+    sampler = bench._start_history(str(tmp_path), {})
+    assert sampler is not None
+    try:
+        sampler.sample_once()
+    finally:
+        sampler.stop(final_sample=False)
+    live = bench._history_report(sampler)
+    assert set(live) == set(r)
+    assert live["history_interval_seconds"] > 0.0
 
 
 @pytest.mark.perf
