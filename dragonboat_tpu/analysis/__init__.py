@@ -28,6 +28,7 @@ from typing import Iterable, List, Optional, Sequence
 
 from .engine import (
     Analyzer,
+    CrossRule,
     Finding,
     FunctionInfo,
     LEGACY_MARK,
@@ -36,15 +37,32 @@ from .engine import (
     unsuppressed,
 )
 from .targets import DEFAULT_TARGETS, LockSpec, Targets
-from . import rules_device, rules_hotpath, rules_locks, rules_retrace
+from . import (
+    rules_device,
+    rules_hotpath,
+    rules_locks,
+    rules_retrace,
+    rules_xlocks,
+    rules_xretrace,
+    rules_xsync,
+)
+
+#: bumped when the rule set / semantics change in a way that invalidates
+#: stored baselines ("1.x" = the PR 5 lexical engine; "2.x" = the
+#: interprocedural call-graph pass). Recorded in --json output and the
+#: longhaul preflight header so a run report pins WHICH gate it passed.
+RULES_VERSION = "2.0"
 
 #: every registered rule, in family order (hotpath -> device -> retrace
-#: -> locks); tools.check --list-rules renders this table
+#: -> locks -> interprocedural); tools.check --list-rules renders this
 ALL_RULES: List[Rule] = (
     list(rules_hotpath.RULES)
     + list(rules_device.RULES)
     + list(rules_retrace.RULES)
     + list(rules_locks.RULES)
+    + list(rules_xlocks.RULES)
+    + list(rules_xretrace.RULES)
+    + list(rules_xsync.RULES)
 )
 
 FAMILIES = sorted({r.id.split("/", 1)[0] for r in ALL_RULES})
@@ -63,7 +81,9 @@ def build_analyzer(
     """The standard analyzer over the dragonboat_tpu package root; narrow
     to specific rule families with `families=("columnar", "locks")`."""
     rules = ALL_RULES if families is None else rules_for_families(families)
-    return Analyzer(rules, targets, root=root)
+    # pragma/unused is only meaningful when every rule ran: a family-
+    # restricted run would call every other family's pragmas dead
+    return Analyzer(rules, targets, root=root, unused_pragmas=families is None)
 
 
 def run_default(paths: Optional[Sequence[str]] = None) -> List[Finding]:
@@ -73,12 +93,14 @@ def run_default(paths: Optional[Sequence[str]] = None) -> List[Finding]:
 __all__ = [
     "ALL_RULES",
     "Analyzer",
+    "CrossRule",
     "DEFAULT_TARGETS",
     "FAMILIES",
     "Finding",
     "FunctionInfo",
     "LEGACY_MARK",
     "LockSpec",
+    "RULES_VERSION",
     "Rule",
     "SourceModule",
     "Targets",

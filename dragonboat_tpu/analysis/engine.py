@@ -36,9 +36,11 @@ test-embedded lint keeps working for the four migrated hot-path families.
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
 import textwrap
+import tokenize
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -97,6 +99,7 @@ class _Pragma:
     rules: Tuple[str, ...]
     reason: str
     standalone: bool  # the line holds only the comment -> applies below
+    src_line: int = 0  # the line the pragma COMMENT is on (0 = synthetic)
 
 
 class FunctionInfo:
@@ -184,8 +187,29 @@ class SourceModule:
         return False
 
     # -- suppression -------------------------------------------------------
+    def _comment_lines(self) -> Optional[Set[int]]:
+        """Line numbers holding REAL comment tokens. Docstrings and string
+        literals that merely MENTION the pragma syntax (this engine's own
+        documentation, for one) must neither suppress findings nor be
+        reported by pragma/unused; tokenize is the only lexically-honest
+        way to tell. None = tokenization failed, treat every regex hit
+        as a comment (fail open: suppressions keep working)."""
+        try:
+            return {
+                tok.start[0]
+                for tok in tokenize.generate_tokens(
+                    io.StringIO("\n".join(self.lines)).readline
+                )
+                if tok.type == tokenize.COMMENT
+            }
+        except (tokenize.TokenError, IndentationError):
+            return None
+
     def _scan_pragmas(self) -> None:
+        comments = self._comment_lines()
         for i, line in enumerate(self.lines, start=1):
+            if comments is not None and i not in comments:
+                continue
             m = _PRAGMA_RE.search(line)
             if m is None:
                 continue
@@ -195,7 +219,7 @@ class SourceModule:
             reason = m.group(2).strip()
             standalone = line.strip().startswith("#")
             if not standalone:
-                self.pragmas[i] = _Pragma(rules, reason, False)
+                self.pragmas[i] = _Pragma(rules, reason, False, i)
                 continue
             # a standalone pragma covers the next CODE line; comment lines
             # in between continue the reason text
@@ -210,7 +234,7 @@ class SourceModule:
                 else:
                     break
             if j <= len(self.lines):
-                self.pragmas.setdefault(j, _Pragma(rules, reason, True))
+                self.pragmas.setdefault(j, _Pragma(rules, reason, True, i))
 
     def suppression_for(self, rule_id: str, line: int) -> Optional[_Pragma]:
         """Pragma covering `rule_id` at `line`: on the same line, or a
@@ -224,7 +248,7 @@ class SourceModule:
                     return p
         if family in LEGACY_MARK_FAMILIES and 0 < line <= len(self.lines):
             if LEGACY_MARK in self.lines[line - 1]:
-                return _Pragma(("*",), "legacy hot-path: ok mark", False)
+                return _Pragma(("*",), "legacy hot-path: ok mark", False, 0)
         return None
 
 
@@ -261,16 +285,52 @@ class Rule:
         )
 
 
+class CrossRule(Rule):
+    """A rule over the whole program — the interprocedural families.
+
+    `check_function` never fires (the Analyzer routes CrossRules through
+    `check_program` instead, once per run, with the resolved call graph).
+    Findings still anchor at a concrete (function, node) site so the
+    same pragma machinery suppresses them."""
+
+    def check_function(self, fn: FunctionInfo, targets) -> Iterable[Finding]:
+        return []
+
+    def check_program(self, program) -> Iterable[Finding]:
+        """`program` is a callgraph.Program: parsed modules + call graph
+        + targets. Yield findings anchored via `self.finding(fn, node,
+        msg)` on the function the site lives in."""
+        raise NotImplementedError
+
+
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class Analyzer:
     """Runs a rule set over a file tree and applies suppressions."""
 
-    def __init__(self, rules: Sequence[Rule], targets, root: str = "") -> None:
+    def __init__(
+        self,
+        rules: Sequence[Rule],
+        targets,
+        root: str = "",
+        unused_pragmas: bool = True,
+    ) -> None:
         self.rules = list(rules)
+        self.function_rules = [r for r in self.rules if not isinstance(r, CrossRule)]
+        self.cross_rules = [r for r in self.rules if isinstance(r, CrossRule)]
         self.targets = targets
         self.root = root or _PKG_ROOT
+        # pragma/unused only makes sense when the FULL rule set ran over
+        # the FULL tree — a family- or path-restricted run would report
+        # every pragma for the excluded rules as dead
+        self.unused_pragmas = unused_pragmas
+        #: (relpath, pragma src line) of every pragma that suppressed
+        #: at least one finding in the last run
+        self._used_pragmas: Set[Tuple[str, int]] = set()
+        #: the callgraph.Program from the last run (check.py --changed
+        #: uses its caller index)
+        self.last_program = None
 
     # -- discovery ---------------------------------------------------------
     def _iter_files(self, paths: Optional[Sequence[str]]):
@@ -316,8 +376,10 @@ class Analyzer:
     # -- run ---------------------------------------------------------------
     def run(self, paths: Optional[Sequence[str]] = None) -> List[Finding]:
         findings: List[Finding] = []
+        modules: List[SourceModule] = []
         seen_functions: Set[Tuple[str, str]] = set()
         seen_modules: Set[str] = set()
+        self._used_pragmas = set()
         for kind, path in self._iter_files(paths):
             if kind == "missing":
                 findings.append(
@@ -341,7 +403,13 @@ class Analyzer:
             seen_modules.add(relpath)
             for fn in mod.functions:
                 seen_functions.add(fn.key())
+            modules.append(mod)
+        for mod in modules:
             findings.extend(self.run_module(mod))
+        if self.cross_rules:
+            findings.extend(self._run_cross(modules))
+        if paths is None and self.unused_pragmas:
+            findings.extend(self._unused_pragma_findings(modules))
         findings.extend(
             self._config_drift(seen_modules, seen_functions)
         )
@@ -349,9 +417,9 @@ class Analyzer:
 
     def run_module(self, mod: SourceModule) -> List[Finding]:
         out: List[Finding] = []
-        dedup: Set[Tuple[str, int, str]] = set()
+        dedup: Set[Tuple] = set()
         for fn in mod.functions:
-            for rule in self.rules:
+            for rule in self.function_rules:
                 for f in rule.check_function(fn, self.targets):
                     key = (f.rule, f.line, f.message)
                     if key in dedup:
@@ -367,6 +435,81 @@ class Analyzer:
     ) -> List[Finding]:
         return self.run_module(SourceModule.from_snippet(source, relpath))
 
+    def run_sources(self, sources: Dict[str, str]) -> List[Finding]:
+        """Run function AND cross rules over in-memory sources (relpath ->
+        source text). The meta-test entry point for interprocedural
+        rules; no drift/unused-pragma checks (the sources are not the
+        real tree)."""
+        modules = [
+            SourceModule.from_snippet(src, rp)
+            for rp, src in sorted(sources.items())
+        ]
+        self._used_pragmas = set()
+        findings: List[Finding] = []
+        for mod in modules:
+            findings.extend(self.run_module(mod))
+        if self.cross_rules:
+            findings.extend(self._run_cross(modules))
+        return findings
+
+    def _run_cross(self, modules: Sequence[SourceModule]) -> List[Finding]:
+        from .callgraph import Program  # deferred: engine has no deps on it
+
+        program = Program(modules, self.targets)
+        self.last_program = program
+        out: List[Finding] = []
+        dedup: Set[Tuple] = set()
+        for rule in self.cross_rules:
+            for f in rule.check_program(program):
+                key = (f.rule, f.path, f.line, f.message)
+                if key in dedup:
+                    continue
+                dedup.add(key)
+                mod = program.module_for_path(f.path)
+                if mod is not None:
+                    self._apply_suppression(mod, f, out, dedup)
+                out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
+    def _unused_pragma_findings(
+        self, modules: Sequence[SourceModule]
+    ) -> List[Finding]:
+        """A `# lint: allow(...)` that suppressed nothing this run is
+        itself a finding: dead suppressions are how rules silently stop
+        enforcing (the code they excused was fixed or moved, the pragma
+        stayed, and the next REAL violation on that line is invisible).
+        Pragmas naming a rule/family in targets.unused_pragma_allowlist
+        are exempt (rules gated off by config fire zero findings by
+        design)."""
+        allow = getattr(self.targets, "unused_pragma_allowlist", set())
+        out: List[Finding] = []
+        for mod in modules:
+            seen_src: Set[int] = set()
+            for _line, p in sorted(mod.pragmas.items()):
+                if p.src_line in seen_src or p.src_line <= 0:
+                    continue
+                seen_src.add(p.src_line)
+                if (mod.relpath, p.src_line) in self._used_pragmas:
+                    continue
+                if any(r in allow for r in p.rules):
+                    continue
+                out.append(
+                    Finding(
+                        "pragma/unused",
+                        mod.path,
+                        p.src_line,
+                        f"allow({', '.join(p.rules)}) suppresses nothing — "
+                        f"delete the pragma (dead suppressions are how "
+                        f"rules silently stop enforcing)",
+                        snippet=mod.lines[p.src_line - 1].strip()
+                        if p.src_line <= len(mod.lines)
+                        else "",
+                    )
+                )
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
     def _apply_suppression(
         self, mod: SourceModule, f: Finding, out: List[Finding], dedup
     ) -> None:
@@ -375,11 +518,13 @@ class Analyzer:
             return
         f.suppressed = True
         f.suppress_reason = p.reason or "(no reason given)"
+        if p.src_line > 0:
+            self._used_pragmas.add((mod.relpath, p.src_line))
         if not p.reason:
             msg = (
                 "suppression carries no reason — every allow() must say why"
             )
-            key = ("pragma/missing-reason", f.line, msg)
+            key = ("pragma/missing-reason", f.path, f.line, msg)
             if key not in dedup:
                 dedup.add(key)
                 out.append(
@@ -417,6 +562,7 @@ def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
 
 __all__ = [
     "Analyzer",
+    "CrossRule",
     "Finding",
     "FunctionInfo",
     "GUARD_HINTS",

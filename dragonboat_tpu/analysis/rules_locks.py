@@ -19,11 +19,12 @@ Conventions honored:
   * nested `def`s do not inherit the enclosing `with` — they run later,
     possibly without the lock (each is checked separately).
 
-Limits (documented, not hidden): the analysis is lexical and
-per-function. A lock taken by a callee is invisible (the `_locked`
-suffix is how callers assert it), and lock objects are recognized by
-`<root>.<attr>` shape with class resolution via `self`/declared variable
-hints. That narrowness is deliberate — findings must be actionable.
+Limits (documented, not hidden): THESE rules are lexical and
+per-function; lock objects are recognized by `<root>.<attr>` shape with
+class resolution via `self`/declared variable hints. The interprocedural
+counterparts live in rules_xlocks.py on top of the static call graph
+(callgraph.py): locks taken by callees, the `*_locked` caller-holds
+convention, and blocking calls under engine-hot locks are checked there.
 """
 from __future__ import annotations
 
@@ -61,11 +62,12 @@ _MUTATORS = frozenset(
 
 
 def _lock_ref(expr: ast.AST) -> Optional[Tuple[str, str]]:
-    """`self._mu` / `sh._wmu` -> (root, attr); None otherwise."""
+    """`self._mu` / `sh._wmu` / `self._sq._cv` -> (dotted root, attr);
+    None otherwise."""
     parts = dotted_parts(expr)
-    if parts is None or len(parts) != 2:
+    if parts is None or len(parts) < 2:
         return None
-    return parts[0], parts[1]
+    return ".".join(parts[:-1]), parts[-1]
 
 
 def _resolve_spec(fn: FunctionInfo, targets, root: str, attr: str):

@@ -1,0 +1,280 @@
+"""Cross-function retrace-hazard taint over the static call graph.
+
+The lexical retrace rules (rules_retrace.py) only see taint born and
+branched on inside ONE function: a traced value handed to a helper —
+`plan = _route_plan(state.term)` two frames down — escaped the analysis
+entirely. This pass runs a program-wide fixpoint:
+
+  * functions the targets declare traced seed their own non-static
+    parameters (same seeding as the lexical rule);
+  * a call argument that references a tainted name taints the matching
+    callee PARAMETER (positional and keyword mapping; `self` offset for
+    method calls; `targets.static_param_names` never taint; static
+    escapes — `x.shape`, `len(x)` — kill taint at the argument, exactly
+    as they do at a branch);
+  * a call to a function whose RETURN references taint taints the
+    assigned name in the caller;
+  * repeat to fixpoint (monotone sets over a finite program).
+
+Findings are the same hazards the lexical rules flag — Python branches
+and concretizations — but ONLY at sites the lexical analysis provably
+misses (a site the lexical rule already reports is not re-reported), and
+each message carries the call-chain provenance of the taint so the fix
+site is obvious.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import FnKey, Program
+from .engine import CrossRule, Finding, FunctionInfo
+from .rules_retrace import _CONCRETIZERS, _traced_name_set, _traced_refs
+
+
+def _param_names(fn: FunctionInfo) -> Tuple[List[str], List[str]]:
+    """(positional params, keyword-only params)."""
+    a = fn.node.args
+    return (
+        [p.arg for p in a.posonlyargs + a.args],
+        [p.arg for p in a.kwonlyargs],
+    )
+
+
+class _Taint:
+    """The program-wide taint state."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.targets = program.targets
+        #: callee param names tainted by some caller
+        self.params: Dict[FnKey, Set[str]] = {}
+        #: provenance: (key, param) -> (caller key, call line)
+        self.prov: Dict[Tuple[FnKey, str], Tuple[FnKey, int]] = {}
+        #: functions whose return value references taint
+        self.returns: Set[FnKey] = set()
+        #: per-function resolved call map: id(call node) -> callee key
+        self.call_map: Dict[FnKey, Dict[int, FnKey]] = {}
+        for key in program.graph.functions:
+            self.call_map[key] = {
+                id(s.node): s.callee
+                for s in program.graph.callees(key)
+            }
+        self._fixpoint()
+
+    def local(self, key: FnKey, precise: bool = False) -> Set[str]:
+        """The tainted-name set of one function under the CURRENT global
+        state: declared-traced seeding plus caller-fed params, propagated
+        through assignments and taint-returning calls.
+
+        `precise=True` drops the coarse all-params seeding of declared-
+        traced functions and keeps only taint that ARRIVED through a call
+        edge. Return-taint is computed from this set: a traced-module
+        helper like `_route_segments(P, K, R)` is called with shape-
+        derived Python ints, and letting its coarse param seeding leak
+        out through its return would taint every caller's plumbing."""
+        fn = self.program.graph.functions[key]
+        if not precise and self.targets.is_traced(key):
+            traced = _traced_name_set(fn, self.targets)
+        else:
+            traced = set()
+        traced |= self.params.get(key, set())
+        cmap = self.call_map.get(key, {})
+
+        def value_tainted(value: ast.AST) -> bool:
+            if _traced_refs(value, traced):
+                return True
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call) and cmap.get(id(sub)) in self.returns:
+                    return True
+            return False
+
+        while True:
+            before = len(traced)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and value_tainted(node.value):
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name):
+                                traced.add(sub.id)
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    if value_tainted(node.value):
+                        traced.add(node.target.id)
+            if len(traced) == before:
+                return traced
+
+    def _fixpoint(self) -> None:
+        graph = self.program.graph
+        static = self.targets.static_param_names
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in graph.functions.items():
+                traced = self.local(key)
+                if not traced:
+                    continue
+                # returns: from PRECISELY-propagated taint only (see
+                # local() — coarse seeding must not leak through returns)
+                if key not in self.returns:
+                    precise = self.local(key, precise=True)
+                    if precise:
+                        for node in ast.walk(fn.node):
+                            if (
+                                isinstance(node, ast.Return)
+                                and node.value is not None
+                                and _traced_refs(node.value, precise)
+                            ):
+                                self.returns.add(key)
+                                changed = True
+                                break
+                # argument propagation
+                for site in graph.callees(key):
+                    callee = graph.functions.get(site.callee)
+                    if callee is None:
+                        continue
+                    pos, kwonly = _param_names(callee)
+                    if pos and pos[0] in ("self", "cls") and site.recv_root:
+                        pos = pos[1:]
+                    tgt = self.params.setdefault(site.callee, set())
+                    for i, arg in enumerate(site.node.args):
+                        if isinstance(arg, ast.Starred) or i >= len(pos):
+                            break
+                        p = pos[i]
+                        if p in static or p in tgt:
+                            continue
+                        if _traced_refs(arg, traced):
+                            tgt.add(p)
+                            self.prov.setdefault(
+                                (site.callee, p), (key, site.lineno)
+                            )
+                            changed = True
+                    for kw in site.node.keywords:
+                        p = kw.arg
+                        if p is None or p in static or p in tgt:
+                            continue
+                        if p not in pos and p not in kwonly:
+                            continue
+                        if _traced_refs(kw.value, traced):
+                            tgt.add(p)
+                            self.prov.setdefault(
+                                (site.callee, p), (key, site.lineno)
+                            )
+                            changed = True
+
+    def chain(self, key: FnKey, names: Set[str]) -> str:
+        """Render the provenance of the first tainted param among `names`
+        back toward a declared-traced root (bounded)."""
+        graph = self.program.graph
+        hops: List[str] = []
+        cur, cur_names = key, names
+        for _ in range(6):
+            hit = None
+            for p in sorted(cur_names):
+                if (cur, p) in self.prov:
+                    hit = (p, self.prov[(cur, p)])
+                    break
+            if hit is None:
+                break
+            p, (caller, line) = hit
+            cq = graph.functions[cur].qualname
+            caller_fn = graph.functions[caller]
+            hops.append(
+                f"`{p}` of {cq} tainted by {caller_fn.qualname} "
+                f"({caller_fn.module.relpath}:{line})"
+            )
+            cur, cur_names = caller, self.params.get(caller, set()) | (
+                _traced_name_set(caller_fn, self.targets)
+                if self.targets.is_traced(caller)
+                else set()
+            )
+        return "; ".join(hops) if hops else "via call-return taint"
+
+
+class CrossFunctionTaint(CrossRule):
+    id = "retrace/cross-function-taint"
+    doc = (
+        "Python branch / iteration / concretization on a value that is "
+        "traced through a CALL CHAIN (argument or return taint) — the "
+        "same retrace hazard the lexical rules flag, at the sites they "
+        "provably cannot see"
+    )
+    motivation = (
+        "ISSUE 20: `plan = helper(state.term)` then `if plan:` two frames "
+        "down forks the trace exactly like a same-function branch, and "
+        "the PR 5 rules missed it by construction"
+    )
+
+    def check_program(self, program: Program) -> Iterable[Finding]:
+        taint = _Taint(program)
+        targets = program.targets
+        for key, fn in program.graph.functions.items():
+            cross = taint.local(key)
+            if not cross:
+                continue
+            # the lexical rule already covers is_traced functions for
+            # their OWN seeding; only report what it cannot see
+            lexical = (
+                _traced_name_set(fn, targets)
+                if targets.is_traced(key)
+                else set()
+            )
+
+            def new_taint(expr: ast.AST) -> bool:
+                return _traced_refs(expr, cross) and not _traced_refs(
+                    expr, lexical
+                )
+
+            for node in ast.walk(fn.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    if new_taint(node.test):
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        yield self.finding(
+                            fn,
+                            node,
+                            f"Python `{kind}` on a cross-function-traced "
+                            f"value ({taint.chain(key, cross)}) — mask "
+                            f"with jnp.where / lax.cond",
+                        )
+                elif isinstance(node, ast.For):
+                    if new_taint(node.iter):
+                        yield self.finding(
+                            fn,
+                            node,
+                            f"Python iteration over a cross-function-"
+                            f"traced value ({taint.chain(key, cross)})",
+                        )
+                elif isinstance(node, ast.IfExp):
+                    if new_taint(node.test):
+                        yield self.finding(
+                            fn,
+                            node,
+                            f"conditional expression on a cross-function-"
+                            f"traced value ({taint.chain(key, cross)})",
+                        )
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    name = ""
+                    if isinstance(f, ast.Name) and f.id in _CONCRETIZERS:
+                        name = f.id
+                    elif (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in ("np", "numpy")
+                        and f.attr in ("asarray", "array")
+                    ):
+                        name = f"np.{f.attr}"
+                    if name and node.args and new_taint(node.args[0]):
+                        yield self.finding(
+                            fn,
+                            node,
+                            f"{name}() concretizes a cross-function-traced "
+                            f"value ({taint.chain(key, cross)}) — "
+                            f"trace-time constant, retrace per value",
+                        )
+
+
+RULES = [CrossFunctionTaint()]
+
+__all__ = ["RULES", "CrossFunctionTaint"]

@@ -91,6 +91,18 @@ class Targets:
     # method-name suffix asserting the caller already holds the lock
     locked_suffix: str = "_locked"
 
+    # ---- interprocedural families (ISSUE 20) ----------------------------
+    # (cls, attr) of locks on the engine step / per-node protocol path: a
+    # blocking call (fsync, .result(), sleep, queue wait) TRANSITIVELY
+    # reachable while one is held stalls the step loop for every lane
+    # (locks/blocking-under-hot-lock)
+    hot_locks: Set[Tuple[str, str]] = field(default_factory=set)
+    # rule ids / families whose allow() pragmas are exempt from
+    # pragma/unused — rules gated off by configuration (empty
+    # device_roots, a family not enabled in this deployment) legitimately
+    # suppress zero findings
+    unused_pragma_allowlist: Set[str] = field(default_factory=set)
+
     # -- queries -----------------------------------------------------------
     def is_hot(self, key: FnKey) -> bool:
         return key in self.hot_functions
@@ -106,6 +118,9 @@ class Targets:
             relpath in self.traced_modules
             and qualname.split(".")[0] not in self.traced_exempt
         )
+
+    def is_hot_lock_spec(self, spec: Optional["LockSpec"]) -> bool:
+        return spec is not None and (spec.cls, spec.attr) in self.hot_locks
 
     def lock_rank(self, cls: Optional[str], attr: str, module=None):
         """Resolve (class, attr) -> LockSpec; subclass names resolve
@@ -521,6 +536,17 @@ def _default_targets() -> Targets:
             "breaker": "_Breaker",
         },
         guarded_state=guarded_state,
+        # blocking work must never be reachable under these: the engine
+        # lane/dirty/snap registries gate the step loop itself, and
+        # Node._mu gates every protocol step and API call on that node.
+        # (_SendQueue._cv is deliberately NOT here: waiting on the send
+        # condition IS its job, and the sender thread owns that latency.)
+        hot_locks={
+            ("VectorEngine", "_lanes_mu"),
+            ("VectorEngine", "_dirty_mu"),
+            ("VectorEngine", "_snap_status_mu"),
+            ("Node", "_mu"),
+        },
     )
 
 

@@ -1793,8 +1793,6 @@ class VectorEngine:
                 self._host_inbox, self._ticks,
                 self._np_route, self._np_rdelta,
             )
-            # lint: allow(locks/lock-in-hot-loop) _MESH_LAUNCH_MU —
-            # uncontended with one engine per process; see its comment
             mu = _MESH_LAUNCH_MU if self._mesh is not None else _NO_LOCK
             with mu:
                 if self._multi_shardings is not None:

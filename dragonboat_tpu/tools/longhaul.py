@@ -180,6 +180,38 @@ class Options:
     # both
     steps_per_sync: int = 1
     shard_over_mesh: bool = False
+    # run `tools.check` (the full static-analysis gate, interprocedural
+    # families included) before round 1 and refuse to start on findings:
+    # hours of longhaul on a tree the sub-second gate already rejects is
+    # the most expensive way to discover a lint failure
+    preflight: bool = True
+
+
+#: preflight verdict memo — one analyzer pass per process (the source
+#: tree does not change under a running longhaul; repeated run_longhaul
+#: calls in one process, e.g. the test suite, pay it once)
+_PREFLIGHT_CACHE: Optional[dict] = None
+
+
+def _preflight_check() -> dict:
+    """The `python -m dragonboat_tpu.tools.check` verdict as a report
+    fragment: findings count + rule version, so a run report pins WHICH
+    gate the tree passed (a longhaul that predates a rule family is not
+    evidence against it)."""
+    global _PREFLIGHT_CACHE
+    if _PREFLIGHT_CACHE is None:
+        from ..analysis import RULES_VERSION, build_analyzer, unsuppressed
+
+        findings = build_analyzer().run()
+        failing = unsuppressed(findings)
+        _PREFLIGHT_CACHE = {
+            "ok": not failing,
+            "findings": len(failing),
+            "suppressed": len(findings) - len(failing),
+            "rule_version": RULES_VERSION,
+            "first": [f.render() for f in failing[:20]],
+        }
+    return dict(_PREFLIGHT_CACHE)
 
 
 def _prepare_out_dir(out_dir: str, reuse: bool = False) -> bool:
@@ -1473,6 +1505,35 @@ def run_longhaul(opts: Options) -> dict:
         + (" (rotated stale run to .prev)" if rotated else ""),
         flush=True,
     )
+    check = {"ok": True, "skipped": True}
+    if opts.preflight:
+        check = _preflight_check()
+        print(
+            f"[longhaul] preflight tools.check: "
+            f"findings={check['findings']} "
+            f"(+{check['suppressed']} suppressed) "
+            f"rules=v{check['rule_version']} -> "
+            f"{'OK' if check['ok'] else 'FAIL'}",
+            flush=True,
+        )
+        if not check["ok"]:
+            for line in check["first"]:
+                print(f"[longhaul]   {line}", flush=True)
+            print(
+                "[longhaul] refusing to start: fix (or suppress with a "
+                "reason) the findings above, or pass --no-preflight",
+                flush=True,
+            )
+            return {
+                "ok": False,
+                "master_seed": master,
+                "rounds": [],
+                "budget_s": opts.budget_s,
+                "out_dir_rotated": rotated,
+                "triage": [],
+                "triage_path": "",
+                "check": check,
+            }
     while time.monotonic() < t_end:
         if opts.rounds_max and round_no >= opts.rounds_max:
             break
@@ -1518,6 +1579,7 @@ def run_longhaul(opts: Options) -> dict:
         "out_dir_rotated": rotated,
         "triage": sorted(triage.values(), key=lambda e: e["signature"]),
         "triage_path": triage_path,
+        "check": check,
     }
 
 
@@ -1567,6 +1629,10 @@ def main(argv=None) -> int:
                     help="shard the vector engine's lane axis over the "
                          "local device mesh (composes with "
                          "--steps-per-sync; scalar ignores)")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="skip the tools.check static-analysis gate that "
+                         "normally runs before round 1 (the run report "
+                         "then records check.skipped)")
     args = ap.parse_args(argv)
     report = run_longhaul(
         Options(
@@ -1581,6 +1647,9 @@ def main(argv=None) -> int:
             inject_failure=args.inject_failure,
             reuse_out=args.reuse_out,
             triage=not args.no_triage,
+            steps_per_sync=args.steps_per_sync,
+            shard_over_mesh=args.shard_over_mesh,
+            preflight=not args.no_preflight,
         )
     )
     return 0 if report["ok"] else 1

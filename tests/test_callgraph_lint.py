@@ -1,0 +1,1012 @@
+"""Meta-tests for the interprocedural analysis layer (ISSUE 20).
+
+Covers, in order:
+  * call-graph resolution unit tests (self/cls methods, module functions,
+    imports, nested closures -> deferred edges, dynamic calls -> no edge,
+    never a crash);
+  * one known-bad snippet per new rule family, with the matching
+    "the PR 5 lexical rules provably miss this" assertion;
+  * allowed-idiom negatives (a `_locked` callee under the right lock,
+    taint killed by `.shape`/`len()`, the CV-wait exemption, blessed
+    seams);
+  * the nested-closure lock regression (deferred edges: created under a
+    `with` is neither "held" for ordering nor an excuse for a naked
+    `_locked` call);
+  * pragma/unused semantics incl. the config-gate allowlist escape;
+  * CLI: `--changed` against a real temp git repo, `--baseline`
+    round-trip, and the `--json` schema pin (rule_version included);
+  * the longhaul preflight fragment (via the memoized check hook).
+
+Everything runs the real `build_analyzer()` rule set through
+`Analyzer.run_sources`, so these tests break when resolution or rule
+semantics drift — that is their job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dragonboat_tpu.analysis import (
+    ALL_RULES,
+    DEFAULT_TARGETS,
+    RULES_VERSION,
+    build_analyzer,
+    unsuppressed,
+)
+from dragonboat_tpu.analysis.callgraph import CallGraph, Program
+from dragonboat_tpu.analysis.engine import Analyzer, CrossRule, SourceModule
+
+pytestmark = pytest.mark.lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _xrun(sources):
+    """Full rule set (lexical + interprocedural) over in-memory sources."""
+    return build_analyzer().run_sources(dict(sources))
+
+
+def _ids(findings, family=None):
+    ids = sorted({f.rule for f in unsuppressed(findings)})
+    if family is not None:
+        ids = [i for i in ids if i.startswith(family)]
+    return ids
+
+
+def _lexical_only(sources):
+    """What the PR 5 per-function rules see — the miss-proof baseline."""
+    rules = [r for r in ALL_RULES if not isinstance(r, CrossRule)]
+    analyzer = Analyzer(rules, DEFAULT_TARGETS)
+    out = []
+    for rel, src in sources.items():
+        out.extend(analyzer.run_snippet(src, rel))
+    return out
+
+
+def _graph(sources) -> CallGraph:
+    mods = [
+        SourceModule.from_snippet(src, rel) for rel, src in sources.items()
+    ]
+    return Program(mods, DEFAULT_TARGETS).graph
+
+
+# ---------------------------------------------------------------- call graph
+
+
+def test_callgraph_resolves_self_method_and_module_function():
+    g = _graph({
+        "m.py": """
+            def helper():
+                pass
+
+            class C:
+                def a(self):
+                    self.b()
+                    helper()
+                def b(self):
+                    pass
+            """,
+    })
+    callees = {s.callee[1] for s in g.callees(("m.py", "C.a"))}
+    assert callees == {"C.b", "helper"}
+    assert [s.caller[1] for s in g.callers(("m.py", "C.b"))] == ["C.a"]
+
+
+def test_callgraph_resolves_method_through_base_class():
+    g = _graph({
+        "m.py": """
+            class Base:
+                def tick(self):
+                    pass
+
+            class Sub(Base):
+                def run(self):
+                    self.tick()
+            """,
+    })
+    assert {s.callee for s in g.callees(("m.py", "Sub.run"))} == {
+        ("m.py", "Base.tick")
+    }
+
+
+def test_callgraph_resolves_package_relative_import():
+    g = _graph({
+        "ops/kernel.py": """
+            from .state import fold
+
+            def step(s):
+                return fold(s)
+            """,
+        "ops/state.py": """
+            def fold(s):
+                return s
+            """,
+    })
+    assert {s.callee for s in g.callees(("ops/kernel.py", "step"))} == {
+        ("ops/state.py", "fold")
+    }
+
+
+def test_callgraph_nested_def_gets_deferred_edge_and_call_edge():
+    g = _graph({
+        "m.py": """
+            class C:
+                def outer(self):
+                    def inner():
+                        pass
+                    inner()
+            """,
+    })
+    edges = g.out_edges[("m.py", "C.outer")]
+    kinds = {(s.callee[1], s.deferred) for s in edges}
+    # one DEFERRED edge (the def itself: runs later, lock-free) and one
+    # normal edge (the direct invocation)
+    assert kinds == {("C.outer.inner", True), ("C.outer.inner", False)}
+
+
+def test_callgraph_dynamic_calls_degrade_to_no_edge():
+    g = _graph({
+        "m.py": """
+            class C:
+                def run(self, cb, items):
+                    cb()                      # unknown callable
+                    getattr(self, "x")()      # dynamic dispatch
+                    items[0].go()             # unknown receiver type
+                    (lambda: self.boom())()   # lambda body not entered
+                def boom(self):
+                    pass
+            """,
+    })
+    assert g.callees(("m.py", "C.run")) == []
+
+
+def test_callgraph_records_held_locks_at_call_sites():
+    g = _graph({
+        "nodehost.py": """
+            class NodeHost:
+                def a(self):
+                    with self._nodes_mu:
+                        self.b()
+                def b(self):
+                    pass
+            """,
+    })
+    (site,) = g.callees(("nodehost.py", "NodeHost.a"))
+    assert [(h.root, h.attr) for h in site.held] == [("self", "_nodes_mu")]
+    assert site.held[0].spec is not None
+    assert site.held[0].spec.cls == "NodeHost"
+
+
+def test_caller_modules_of_reports_cross_module_callers():
+    g = _graph({
+        "a.py": "def f():\n    pass\n",
+        "b.py": "from .a import f\n\ndef g():\n    f()\n",
+    })
+    assert g.caller_modules_of({"a.py"}) == {"b.py"}
+
+
+# ----------------------------------------------------- locks/cross-function
+
+
+_INVERSION = {
+    "engine/node.py": """
+        class Node:
+            def api(self):
+                with self._mu:
+                    self._lookup()
+            def _lookup(self):
+                with self._nodes_mu:
+                    pass
+        """,
+}
+
+
+def test_cross_function_lock_inversion_is_caught():
+    findings = [
+        f
+        for f in unsuppressed(_xrun(_INVERSION))
+        if f.rule == "locks/cross-function-order"
+    ]
+    assert len(findings) == 1, findings
+    msg = findings[0].message
+    assert "Node._mu (rank 41)" in msg
+    assert "NodeHost._nodes_mu (rank 38)" in msg
+    assert "Node._lookup" in msg  # the witness chain
+
+
+def test_cross_function_lock_inversion_missed_by_lexical_rules():
+    assert _ids(_lexical_only(_INVERSION), "locks") == []
+
+
+def test_cross_function_order_two_frames_down():
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def api(self):
+                    with self._mu:
+                        self._mid()
+                def _mid(self):
+                    self._deep()
+                def _deep(self):
+                    with self._nodes_mu:
+                        pass
+            """,
+    })
+    msgs = [
+        f.message
+        for f in unsuppressed(findings)
+        if f.rule == "locks/cross-function-order"
+    ]
+    assert any("Node._mid -> Node._deep" in m for m in msgs), msgs
+
+
+def test_cross_function_order_inner_rank_is_clean():
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def api(self):
+                    with self._nodes_mu:
+                        self._lookup()
+                def _lookup(self):
+                    with self._mu:
+                        pass
+            """,
+    })
+    # 38 held, 41 acquired: acquisition goes DOWN the table — legal
+    assert _ids(findings, "locks/cross-function-order") == []
+
+
+def test_same_lock_reacquired_through_chain_is_flagged():
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def api(self):
+                    with self._mu:
+                        self._again()
+                def _again(self):
+                    with self._mu:
+                        pass
+            """,
+    })
+    msgs = [
+        f.message
+        for f in unsuppressed(findings)
+        if f.rule == "locks/cross-function-order"
+    ]
+    assert any("same lock reacquired" in m for m in msgs), msgs
+
+
+# ------------------------------------------------- locks/locked-callee-unheld
+
+
+def test_locked_callee_without_lock_is_flagged():
+    findings = _xrun({
+        "transport/chunks.py": """
+            class Chunks:
+                def sweep(self):
+                    self._expire_locked()
+                def _expire_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == [
+        "locks/locked-callee-unheld"
+    ]
+
+
+def test_locked_callee_under_declared_lock_is_clean():
+    findings = _xrun({
+        "transport/chunks.py": """
+            class Chunks:
+                def sweep(self):
+                    with self._mu:
+                        self._expire_locked()
+                def _expire_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == []
+
+
+def test_locked_callee_from_locked_sibling_is_clean():
+    findings = _xrun({
+        "transport/chunks.py": """
+            class Chunks:
+                def _sweep_locked(self):
+                    self._expire_locked()
+                def _expire_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == []
+
+
+def test_locked_callee_under_auxiliary_receiver_lock_is_clean():
+    # Node declares only _mu, but an undeclared one-shot mutex on the
+    # SAME receiver (the Node._init_mu recovery pattern) satisfies the
+    # caller-holds convention
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def recover(self):
+                    with self._init_mu:
+                        self._recover_locked()
+                def _recover_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == []
+
+
+def test_locked_callee_on_other_receiver_lock_is_flagged():
+    # holding YOUR OWN lock does not license a naked call into another
+    # object's _locked method
+    findings = _xrun({
+        "nodehost.py": """
+            class NodeHost:
+                def sweep(self, node):
+                    with self._nodes_mu:
+                        node._expire_locked()
+            """,
+        "engine/node.py": """
+            class Node:
+                def _expire_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == [
+        "locks/locked-callee-unheld"
+    ]
+
+
+# ------------------------------------------- locks/blocking-under-hot-lock
+
+
+_BLOCKING = {
+    "engine/vector.py": """
+        import os
+        import time
+
+        class VectorEngine:
+            def tick(self):
+                with self._lanes_mu:
+                    self._spill()
+            def _spill(self):
+                self._sync()
+            def _sync(self):
+                os.fsync(3)
+        """,
+}
+
+
+def test_blocking_reachable_under_hot_lock_is_caught():
+    findings = [
+        f
+        for f in unsuppressed(_xrun(_BLOCKING))
+        if f.rule == "locks/blocking-under-hot-lock"
+    ]
+    assert len(findings) == 1, findings
+    assert "fsync()" in findings[0].message
+    assert "VectorEngine._spill -> VectorEngine._sync" in findings[0].message
+
+
+def test_blocking_under_hot_lock_missed_by_lexical_rules():
+    assert _ids(_lexical_only(_BLOCKING), "locks") == []
+
+
+def test_direct_sleep_under_hot_lock_is_caught():
+    findings = _xrun({
+        "engine/vector.py": """
+            import time
+
+            class VectorEngine:
+                def tick(self):
+                    with self._dirty_mu:
+                        time.sleep(0.1)
+            """,
+    })
+    assert _ids(findings, "locks/blocking-under-hot-lock") == [
+        "locks/blocking-under-hot-lock"
+    ]
+
+
+def test_cv_wait_on_held_lock_is_exempt():
+    # waiting ON the condition you hold is the CV idiom, not a stall bug
+    # (and _SendQueue._cv is deliberately not an engine-hot lock)
+    findings = _xrun({
+        "transport/transport.py": """
+            class _SendQueue:
+                def get(self):
+                    with self._cv:
+                        self._cv.wait(1.0)
+            """,
+    })
+    assert _ids(findings, "locks") == []
+
+
+def test_blocking_under_cold_lock_is_clean():
+    findings = _xrun({
+        "storage/logdb.py": """
+            import os
+
+            class _Shard:
+                def flush(self):
+                    with self._wmu:
+                        os.fsync(3)
+            """,
+    })
+    # _Shard._wmu is the WAL writer lock: fsync under it is its JOB
+    assert _ids(findings, "locks/blocking-under-hot-lock") == []
+
+
+# --------------------------------------------------- nested-def regression
+
+
+def test_deferred_closure_acquisition_not_treated_as_nested():
+    # the closure is CREATED under Node._mu but runs later: its
+    # NodeHost._nodes_mu acquisition is not nested inside _mu and must
+    # not produce a cross-function-order finding
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def api(self):
+                    with self._mu:
+                        def later():
+                            with self._nodes_mu:
+                                pass
+                        self.defer = later
+            """,
+    })
+    assert _ids(findings, "locks/cross-function-order") == []
+
+
+def test_deferred_closure_calling_locked_method_is_flagged():
+    # "closure called later, lock not held" made explicit: the closure
+    # body's naked _locked call is a finding even though the enclosing
+    # function holds the lock at CREATION time
+    findings = _xrun({
+        "transport/chunks.py": """
+            class Chunks:
+                def arm(self):
+                    with self._mu:
+                        def cb():
+                            self._expire_locked()
+                        self.cb = cb
+                def _expire_locked(self):
+                    pass
+            """,
+    })
+    assert _ids(findings, "locks/locked-callee-unheld") == [
+        "locks/locked-callee-unheld"
+    ]
+
+
+def test_closure_invoked_directly_under_with_keeps_held_set():
+    # direct invocation INSIDE the with: the call edge carries the held
+    # lock, so the closure's inner acquisition is checked as nested
+    findings = _xrun({
+        "engine/node.py": """
+            class Node:
+                def api(self):
+                    with self._mu:
+                        def inner():
+                            with self._nodes_mu:
+                                pass
+                        inner()
+            """,
+    })
+    assert _ids(findings, "locks/cross-function-order") == [
+        "locks/cross-function-order"
+    ]
+
+
+# ------------------------------------------------ retrace/cross-function-taint
+
+
+_HELPER_BRANCH = {
+    "ops/kernel.py": """
+        from .state import pick
+
+        def step(state, cfg):
+            return pick(state)
+        """,
+    "ops/state.py": """
+        def pick(x):
+            if x:
+                return 1
+            return 0
+        """,
+}
+
+
+def test_traced_value_branched_in_helper_is_caught():
+    findings = [
+        f
+        for f in unsuppressed(_xrun(_HELPER_BRANCH))
+        if f.rule == "retrace/cross-function-taint"
+    ]
+    assert len(findings) == 1, findings
+    assert findings[0].path == "ops/state.py"
+    assert "`x` of pick tainted by step" in findings[0].message
+
+
+def test_helper_branch_missed_by_lexical_rules():
+    assert _ids(_lexical_only(_HELPER_BRANCH), "retrace") == []
+
+
+def test_taint_killed_by_static_escapes():
+    findings = _xrun({
+        "ops/kernel.py": """
+            from .state import pick
+
+            def step(state, cfg):
+                return pick(state)
+            """,
+        "ops/state.py": """
+            def pick(x):
+                n = x.shape[0]
+                if n > 2:          # shape: a Python int at trace time
+                    return 1
+                if len(x) > 4:     # len(): same
+                    return 2
+                return 0
+            """,
+    })
+    assert _ids(findings, "retrace/cross-function-taint") == []
+
+
+def test_return_taint_flows_back_to_callers():
+    # context-insensitive by design: once SOME traced caller taints
+    # pick's param, pick's return is tainted for EVERY caller — `other`
+    # never passes a traced value itself, and the lexical rules (which
+    # conservatively taint any assignment mentioning a traced name)
+    # cannot see this at all
+    sources = {
+        "ops/kernel.py": """
+            from .state import pick
+
+            def step(state, cfg):
+                return pick(state)
+            """,
+        "ops/state.py": """
+            def pick(x):
+                y = x
+                return y
+
+            def other(n):
+                flag = pick(n)
+                while flag:
+                    flag = 0
+            """,
+    }
+    msgs = [
+        f.message
+        for f in unsuppressed(_xrun(sources))
+        if f.rule == "retrace/cross-function-taint"
+    ]
+    assert any("while" in m and "other" in m for m in msgs), msgs
+    assert _ids(_lexical_only(sources), "retrace") == []
+
+
+def test_untraced_caller_does_not_taint_helper():
+    # a host-side (untraced) caller passing host values taints nothing —
+    # the chain must originate in declared-traced code
+    findings = _xrun({
+        "nodehost.py": """
+            from .util import pick
+
+            class NodeHost:
+                def admin(self, req):
+                    return pick(req)
+            """,
+        "util.py": """
+            def pick(x):
+                if x:
+                    return 1
+                return 0
+            """,
+    })
+    assert _ids(findings, "retrace/cross-function-taint") == []
+
+
+def test_shape_derived_args_do_not_leak_taint_through_returns():
+    # the _route_segments shape: a traced-module helper CALLED with
+    # shape-derived Python ints must not taint its caller's plumbing
+    # through its return value (its coarse all-params seeding is a
+    # lexical-analysis convention, not real arg taint)
+    findings = _xrun({
+        "ops/kernel.py": """
+            def segments(p, k):
+                return [p, k, p + k]
+
+            def route(s, cfg):
+                gl, p = s.member.shape
+                segs = segments(p, 4)
+                parts = []
+                for seg in segs:
+                    parts.append(seg)
+                return parts
+            """,
+    })
+    assert _ids(findings, "retrace/cross-function-taint") == []
+
+
+# ------------------------------------------------- device-sync/cross-function
+
+
+_HIDDEN_SYNC = {
+    "engine/vector.py": """
+        import jax
+
+        class VectorEngine:
+            def _decode(self):
+                return self._probe()
+            def _probe(self):
+                return jax.device_get(self._state.term)
+        """,
+}
+
+
+def test_device_get_in_helper_reachable_from_hot_is_caught():
+    findings = [
+        f
+        for f in unsuppressed(_xrun(_HIDDEN_SYNC))
+        if f.rule == "device-sync/cross-function"
+    ]
+    assert len(findings) == 1, findings
+    assert "VectorEngine._decode -> VectorEngine._probe" in findings[0].message
+
+
+def test_hidden_sync_missed_by_lexical_rules():
+    assert _ids(_lexical_only(_HIDDEN_SYNC), "device-sync") == []
+
+
+def test_chain_through_blessed_seam_is_clean():
+    findings = _xrun({
+        "engine/vector.py": """
+            import jax
+
+            class VectorEngine:
+                def _decode(self):
+                    return self._fetch_output()
+                def _fetch_output(self):
+                    return jax.device_get(self._state)
+            """,
+    })
+    assert _ids(findings, "device-sync/cross-function") == []
+
+
+def test_item_on_device_root_in_reachable_helper_is_caught():
+    findings = _xrun({
+        "engine/vector.py": """
+            class VectorEngine:
+                def _decode(self):
+                    return self._one()
+                def _one(self):
+                    return self._state.term[0].item()
+            """,
+    })
+    assert _ids(findings, "device-sync/cross-function") == [
+        "device-sync/cross-function"
+    ]
+
+
+def test_item_outside_hot_modules_is_not_a_device_sync():
+    # `self._state` only names the device plane in modules that host hot
+    # functions; a Node._state.item() is ordinary host state even when
+    # the function is REACHABLE from a hot root
+    findings = _xrun({
+        "engine/vector.py": """
+            from .node import probe
+
+            class VectorEngine:
+                def _decode(self):
+                    return probe(None)
+            """,
+        "engine/node.py": """
+            def probe(node):
+                return node._stat()
+
+            class Node:
+                def _stat(self):
+                    return self._state.item()
+            """,
+    })
+    assert _ids(findings, "device-sync/cross-function") == []
+
+
+# ----------------------------------------------------------- pragma/unused
+
+
+def _overlay_run(tmp_path, files, targets=None, families=None):
+    root = tmp_path / "overlay"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    analyzer = build_analyzer(
+        families=families,
+        targets=targets or DEFAULT_TARGETS,
+        root=str(root),
+    )
+    return analyzer.run(None)
+
+
+_BAD_WITH_PRAGMA = (
+    "import jax\n"
+    "\n"
+    "class VectorEngine:\n"
+    "    def _decode(self):\n"
+    "        jax.device_get(self._x)  "
+    "# lint: allow(device-sync) one-off probe\n"
+)
+
+
+def test_used_pragma_is_not_reported(tmp_path):
+    findings = _overlay_run(
+        tmp_path, {"engine/vector.py": _BAD_WITH_PRAGMA}
+    )
+    assert _ids(findings, "pragma") == []
+
+
+def test_unused_pragma_is_reported(tmp_path):
+    findings = _overlay_run(
+        tmp_path,
+        {
+            "engine/vector.py": (
+                "class VectorEngine:\n"
+                "    def _decode(self):\n"
+                "        return 1  # lint: allow(device-sync) stale\n"
+            )
+        },
+    )
+    pragma = [f for f in unsuppressed(findings) if f.rule == "pragma/unused"]
+    assert len(pragma) == 1, findings
+    assert pragma[0].line == 3
+
+
+def test_unused_pragma_allowlist_escape(tmp_path):
+    import dataclasses
+
+    targets = dataclasses.replace(
+        DEFAULT_TARGETS, unused_pragma_allowlist={"device-sync"}
+    )
+    findings = _overlay_run(
+        tmp_path,
+        {
+            "engine/vector.py": (
+                "class VectorEngine:\n"
+                "    def _decode(self):\n"
+                "        return 1  # lint: allow(device-sync) config-gated\n"
+            )
+        },
+        targets=targets,
+    )
+    assert _ids(findings, "pragma") == []
+
+
+def test_unused_pragma_silent_on_family_restricted_runs(tmp_path):
+    findings = _overlay_run(
+        tmp_path,
+        {
+            "engine/vector.py": (
+                "class VectorEngine:\n"
+                "    def _decode(self):\n"
+                "        return 1  # lint: allow(device-sync) stale\n"
+            )
+        },
+        families=("locks",),
+    )
+    assert _ids(findings, "pragma") == []
+
+
+def test_docstring_mention_of_pragma_syntax_is_not_a_pragma(tmp_path):
+    # documentation QUOTING the pragma syntax must neither suppress nor
+    # show up as pragma/unused — only real comment tokens count
+    findings = _overlay_run(
+        tmp_path,
+        {
+            "engine/vector.py": (
+                'HOWTO = """suppress with `# lint: allow(locks) why`"""\n'
+                "\n"
+                "class VectorEngine:\n"
+                "    def _decode(self):\n"
+                "        return 1\n"
+            )
+        },
+    )
+    assert _ids(findings, "pragma") == []
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _check_cli(*argv, cwd=_REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "dragonboat_tpu.tools.check", *argv],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def _write_overlay(root, files):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+
+
+_CLEAN = "class VectorEngine:\n    def _decode(self):\n        return 1\n"
+_BAD = (
+    "import jax\n\n"
+    "class VectorEngine:\n"
+    "    def _decode(self):\n"
+    "        return jax.device_get(self._x)\n"
+)
+
+
+def test_cli_json_schema_is_pinned(tmp_path):
+    root = tmp_path / "overlay"
+    _write_overlay(root, {"engine/vector.py": _BAD})
+    p = _check_cli("--json", "--root", str(root), str(root))
+    assert p.returncode == 1, p.stdout + p.stderr
+    out = json.loads(p.stdout)
+    assert set(out) == {
+        "findings",
+        "unsuppressed",
+        "suppressed",
+        "ok",
+        "rule_version",
+    }
+    assert out["rule_version"] == RULES_VERSION
+    assert out["ok"] is False and out["unsuppressed"] >= 1
+    assert set(out["findings"][0]) == {
+        "rule",
+        "path",
+        "line",
+        "message",
+        "snippet",
+        "suppressed",
+        "suppress_reason",
+    }
+
+
+def test_cli_baseline_roundtrip(tmp_path):
+    root = tmp_path / "overlay"
+    _write_overlay(root, {"engine/vector.py": _BAD})
+    snap = _check_cli("--json", "--root", str(root), str(root))
+    base = tmp_path / "baseline.json"
+    base.write_text(snap.stdout)
+
+    # same tree vs its own snapshot: nothing new -> exit 0
+    p = _check_cli(
+        "--baseline", str(base), "--root", str(root), str(root)
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 new, 0 fixed" in p.stdout
+
+    # add a fresh violation: exactly the NEW one fails
+    _write_overlay(
+        root,
+        {
+            "engine/vector.py": _BAD
+            + "    def _pack(self):\n"
+            + "        return jax.device_get(self._y)\n"
+        },
+    )
+    p = _check_cli(
+        "--baseline", str(base), "--root", str(root), str(root)
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "_pack" in p.stdout
+    assert "_decode" not in p.stdout  # old debt is baseline-excused
+
+    # fix everything: exit 0 and the fixed count is reported
+    _write_overlay(root, {"engine/vector.py": _CLEAN})
+    p = _check_cli(
+        "--baseline", str(base), "--root", str(root), str(root)
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "1 fixed" in p.stdout
+
+
+def _git(root, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+
+
+def test_cli_changed_mode_filters_to_diff_plus_callers(tmp_path):
+    root = tmp_path / "overlay"
+    _write_overlay(
+        root,
+        {
+            # pre-existing debt in an UNCHANGED file: filtered out
+            "engine/vector.py": _BAD,
+            # clean helper module, about to change
+            "ops/state.py": "def fold(s):\n    return s\n",
+            # kernel calls the helper -> caller-module expansion target
+            "ops/kernel.py": (
+                "from .state import fold\n\n"
+                "def step(state, cfg):\n"
+                "    return fold(state)\n"
+            ),
+        },
+    )
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+
+    # change ONLY the helper: give it a branch on its (tainted) param
+    (root / "ops/state.py").write_text(
+        "def fold(s):\n    if s:\n        return 1\n    return s\n"
+    )
+    p = _check_cli("--changed", "HEAD", "--root", str(root))
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "cross-function-taint" in p.stdout
+    # the unchanged file's debt is out of scope for --changed
+    assert "device-sync" not in p.stdout
+    assert "1 file(s)" in p.stdout and "caller module(s)" in p.stdout
+
+    # against a clean worktree nothing is in scope -> exit 0
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "helper branch")
+    p = _check_cli("--changed", "HEAD", "--root", str(root))
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_changed_outside_git_fails_loudly(tmp_path):
+    root = tmp_path / "overlay"
+    _write_overlay(root, {"engine/vector.py": _CLEAN})
+    env = dict(os.environ, GIT_CEILING_DIRECTORIES=str(tmp_path))
+    p = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "dragonboat_tpu.tools.check",
+            "--changed",
+            "--root",
+            str(root),
+        ],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    # tmp overlay is not a git repo (ceiling blocks the crawl upward):
+    # exit 2, NOT a clean-looking 0
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+# -------------------------------------------------------- contract guards
+
+
+def test_interprocedural_rules_are_registered():
+    ids = {r.id for r in ALL_RULES}
+    assert {
+        "locks/cross-function-order",
+        "locks/locked-callee-unheld",
+        "locks/blocking-under-hot-lock",
+        "retrace/cross-function-taint",
+        "device-sync/cross-function",
+    } <= ids
+
+
+def test_cross_rules_never_fire_lexically():
+    # the Analyzer routes CrossRules through check_program; their
+    # check_function must be inert so family-restricted per-module runs
+    # stay sound
+    for r in ALL_RULES:
+        if isinstance(r, CrossRule):
+            assert list(r.check_function(None, DEFAULT_TARGETS)) == []

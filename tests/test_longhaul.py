@@ -342,3 +342,68 @@ def test_longhaul_lease_clock_chaos_round_replays_bit_identical(tmp_path):
         runs.append(r)
     assert runs[0].signature == runs[1].signature
     assert runs[0].scenarios == runs[1].scenarios
+
+
+def test_longhaul_preflight_verdict_recorded_in_report(tmp_path, capsys):
+    """Every run report pins WHICH static-analysis gate the tree passed
+    (findings count + rule version), and the header says so (ISSUE 20:
+    tools.check is the pre-merge bar, longhaul refuses dirty trees)."""
+    report = run_longhaul(
+        Options(budget_s=0.0, out_dir=str(tmp_path / "run"), seed=1, ring=False)
+    )
+    check = report["check"]
+    assert check["ok"] is True
+    assert check["findings"] == 0
+    assert check["rule_version"].startswith("2.")
+    assert "skipped" not in check
+    out = capsys.readouterr().out
+    assert "preflight tools.check:" in out
+    assert "-> OK" in out
+
+
+def test_longhaul_preflight_failure_refuses_to_start(
+    tmp_path, capsys, monkeypatch
+):
+    from dragonboat_tpu.tools import longhaul as lh
+
+    monkeypatch.setattr(
+        lh,
+        "_preflight_check",
+        lambda: {
+            "ok": False,
+            "findings": 2,
+            "suppressed": 0,
+            "rule_version": "2.0",
+            "first": ["engine/vector.py:1: [device-sync/device-get] boom"],
+        },
+    )
+    report = run_longhaul(
+        Options(
+            budget_s=30.0,
+            rounds_max=1,
+            round_s=2.0,
+            out_dir=str(tmp_path / "run"),
+            seed=1,
+            ring=False,
+        )
+    )
+    assert report["ok"] is False
+    assert report["rounds"] == []  # zero rounds ran on a dirty tree
+    assert report["check"]["findings"] == 2
+    out = capsys.readouterr().out
+    assert "-> FAIL" in out
+    assert "refusing to start" in out
+    assert "[device-sync/device-get]" in out
+
+
+def test_longhaul_no_preflight_skips_the_gate(tmp_path):
+    report = run_longhaul(
+        Options(
+            budget_s=0.0,
+            out_dir=str(tmp_path / "run"),
+            seed=1,
+            ring=False,
+            preflight=False,
+        )
+    )
+    assert report["check"] == {"ok": True, "skipped": True}

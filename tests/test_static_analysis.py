@@ -23,6 +23,7 @@ import pytest
 from dragonboat_tpu.analysis import (
     ALL_RULES,
     FAMILIES,
+    RULES_VERSION,
     build_analyzer,
     unsuppressed,
 )
@@ -60,6 +61,17 @@ def test_every_rule_documents_itself():
         assert r.doc, r.id
         assert r.motivation, r.id
     assert len({r.id for r in ALL_RULES}) == len(ALL_RULES)
+    # the interprocedural layer (ISSUE 20) is registered, and the rule
+    # version reflects it — stored baselines pin WHICH engine judged them
+    ids = {r.id for r in ALL_RULES}
+    assert {
+        "locks/cross-function-order",
+        "locks/locked-callee-unheld",
+        "locks/blocking-under-hot-lock",
+        "retrace/cross-function-taint",
+        "device-sync/cross-function",
+    } <= ids
+    assert RULES_VERSION.startswith("2.")
 
 
 def test_cli_clean_tree_exits_zero():
